@@ -1,0 +1,70 @@
+"""Config tree tests (pattern: reference veles/tests/test_config.py)."""
+
+import pytest
+
+from veles_tpu.config import Config, Range, fix_config, get_config_ranges, \
+    set_config_by_path
+
+
+def test_autovivify():
+    c = Config("test")
+    c.a.b.value = 3
+    assert c.a.b.value == 3
+    assert c.a.b.path == "test.a.b"
+
+
+def test_update_nested():
+    c = Config("test")
+    c.update({"x": {"y": 1, "z": {"w": 2}}, "top": "s"})
+    assert c.x.y == 1
+    assert c.x.z.w == 2
+    assert c.top == "s"
+    c.update({"x": {"y": 10}})
+    assert c.x.y == 10
+    assert c.x.z.w == 2  # merge keeps siblings
+
+
+def test_update_rejects_scalar():
+    c = Config("test")
+    with pytest.raises(TypeError):
+        c.update(42)
+
+
+def test_protected_keys():
+    c = Config("test")
+    with pytest.raises(AttributeError):
+        setattr(c, "update", 5)
+    with pytest.raises(AttributeError):
+        setattr(c, "get", 5)
+
+
+def test_get_resolves_callables_and_ranges():
+    c = Config("test")
+    c.update({"lr": Range(0.1, 0.001, 1.0), "fn": lambda: 7, "plain": 3})
+    assert c.get("lr") == 0.1
+    assert c.get("fn") == 7
+    assert c.get("plain") == 3
+    assert c.get("absent", "d") == "d"
+
+
+def test_fix_config_collapses_ranges():
+    c = Config("test")
+    c.update({"a": Range(5, 0, 10), "sub": {"b": Range(1, [1, 2, 3])}})
+    fix_config(c)
+    assert c.a == 5
+    assert c.sub.b == 1
+
+
+def test_get_config_ranges_and_set_by_path():
+    c = Config("root")
+    c.update({"a": Range(5, 0, 10), "sub": {"b": Range("x", ["x", "y"])}})
+    ranges = dict(get_config_ranges(c))
+    assert set(ranges) == {"root.a", "root.sub.b"}
+    set_config_by_path(c, "root.sub.b", "y")
+    assert c.sub.b == "y"
+
+
+def test_todict_roundtrip():
+    c = Config("test")
+    c.update({"a": 1, "s": {"b": 2}})
+    assert c.todict() == {"a": 1, "s": {"b": 2}}

@@ -1,0 +1,81 @@
+"""Bool expression algebra tests (pattern: reference veles/tests/test_mutable.py)."""
+
+import pickle
+
+import pytest
+
+from veles_tpu.mutable import Bool
+
+
+def test_plain_assignment():
+    b = Bool(False)
+    assert not b
+    b <<= True
+    assert b
+    b <<= False
+    assert not b
+
+
+def test_or_tracks_operands():
+    a, b = Bool(False), Bool(False)
+    expr = a | b
+    assert not expr
+    b <<= True
+    assert expr
+    b <<= False
+    a <<= True
+    assert expr
+
+
+def test_and_invert_xor():
+    a, b = Bool(True), Bool(False)
+    assert not (a & b)
+    assert a & ~b
+    assert a ^ b
+    b <<= True
+    assert not (a ^ b)
+    assert a & b
+
+
+def test_compound_expression():
+    a, b, c = Bool(False), Bool(False), Bool(False)
+    expr = (a | b) & ~c
+    assert not expr
+    a <<= True
+    assert expr
+    c <<= True
+    assert not expr
+
+
+def test_cannot_assign_derived():
+    a, b = Bool(), Bool()
+    expr = a | b
+    with pytest.raises(ValueError):
+        expr <<= True
+
+
+def test_coerce_plain_values():
+    a = Bool(False)
+    expr = a | True
+    assert expr
+    expr2 = a & False
+    assert not expr2
+
+
+def test_edge_callbacks():
+    fired = []
+    b = Bool(False)
+    b.on_true = lambda: fired.append("t")
+    b.on_false = lambda: fired.append("f")
+    b <<= True
+    b <<= True  # no edge
+    b <<= False
+    assert fired == ["t", "f"]
+
+
+def test_pickle_flattens_expression():
+    a, b = Bool(True), Bool(False)
+    expr = a | b
+    restored = pickle.loads(pickle.dumps(expr))
+    assert bool(restored) is True
+    assert not restored.is_derived

@@ -1,0 +1,295 @@
+"""Unit graph engine tests (patterns: reference veles/tests/test_units.py,
+test_workflow.py — gates, loops, initialize order, stop semantics)."""
+
+import pickle
+
+import pytest
+
+from veles_tpu import Bool, Repeater, TrivialUnit, Unit, Workflow
+
+
+class CountingUnit(TrivialUnit):
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.runs = []
+        self.counter = 0
+
+    def run(self):
+        self.counter += 1
+        trace = getattr(self.workflow, "trace", None)
+        if trace is not None:
+            trace.append(self.name)
+
+
+def make_wf(**kwargs):
+    wf = Workflow(**kwargs)
+    wf.trace = []
+    return wf
+
+
+def test_linear_chain_runs_in_order():
+    wf = make_wf()
+    a = CountingUnit(wf, name="a")
+    b = CountingUnit(wf, name="b")
+    c = CountingUnit(wf, name="c")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(b)
+    wf.end_point.link_from(c)
+    wf.initialize()
+    wf.run()
+    assert wf.trace == ["a", "b", "c"]
+    assert wf.is_finished
+
+
+def test_and_gate_waits_for_all_inputs():
+    wf = make_wf()
+    a = CountingUnit(wf, name="a")
+    b = CountingUnit(wf, name="b")
+    join = CountingUnit(wf, name="join")
+    a.link_from(wf.start_point)
+    b.link_from(wf.start_point)
+    join.link_from(a, b)
+    wf.end_point.link_from(join)
+    wf.initialize()
+    wf.run()
+    assert join.counter == 1
+    assert wf.trace[-1] == "join"
+
+
+def test_gate_skip_propagates_without_running():
+    wf = make_wf()
+    a = CountingUnit(wf, name="a")
+    b = CountingUnit(wf, name="b")
+    c = CountingUnit(wf, name="c")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(b)
+    wf.end_point.link_from(c)
+    b.gate_skip <<= True
+    wf.initialize()
+    wf.run()
+    assert wf.trace == ["a", "c"]
+    assert b.counter == 0
+
+
+def test_gate_block_stops_propagation():
+    wf = make_wf()
+    a = CountingUnit(wf, name="a")
+    b = CountingUnit(wf, name="b")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    wf.end_point.link_from(b)
+    b.gate_block <<= True
+    wf.initialize()
+    wf.run()
+    assert b.counter == 0
+    assert not wf.is_finished  # nothing reached the end point
+
+
+def test_repeater_loop_until_condition():
+    wf = make_wf()
+    rep = Repeater(wf)
+    body = CountingUnit(wf, name="body")
+    done = Bool(False)
+
+    class Decision(CountingUnit):
+        def run(self):
+            nonlocal done
+            super().run()
+            if self.counter >= 5:
+                done <<= True
+
+    dec = Decision(wf, name="dec")
+    rep.link_from(wf.start_point)
+    body.link_from(rep)
+    dec.link_from(body)
+    rep.link_from(dec)          # loop back
+    wf.end_point.link_from(dec)
+    rep.gate_block = done       # stop looping when done
+    wf.end_point.gate_block = ~done
+    wf.initialize()
+    wf.run()
+    assert body.counter == 5
+    assert wf.is_finished
+
+
+def test_link_attrs_live_pointer():
+    wf = make_wf()
+    src = CountingUnit(wf, name="src")
+    dst = CountingUnit(wf, name="dst")
+    src.payload = 1
+    dst.link_attrs(src, "payload")
+    assert dst.payload == 1
+    src.payload = 42
+    assert dst.payload == 42
+    # one-way write breaks the link
+    dst.payload = 7
+    assert dst.payload == 7
+    assert src.payload == 42
+
+
+def test_link_attrs_two_way():
+    wf = make_wf()
+    src = CountingUnit(wf, name="src")
+    dst = CountingUnit(wf, name="dst")
+    src.value = 1
+    dst.link_attrs(src, "value", two_way=True)
+    dst.value = 9
+    assert src.value == 9
+
+
+def test_link_attrs_renaming_and_missing():
+    wf = make_wf()
+    src = CountingUnit(wf, name="src")
+    dst = CountingUnit(wf, name="dst")
+    src.output = "x"
+    dst.link_attrs(src, ("input", "output"))
+    assert dst.input == "x"
+    with pytest.raises(AttributeError):
+        dst.link_attrs(src, "no_such_attr")
+
+
+def test_initialize_dependency_order():
+    wf = make_wf()
+    order = []
+
+    class Init(TrivialUnit):
+        def initialize(self, **kwargs):
+            super().initialize(**kwargs)
+            order.append(self.name)
+
+    a = Init(wf, name="a")
+    b = Init(wf, name="b")
+    c = Init(wf, name="c")
+    c.link_from(b)
+    b.link_from(a)
+    a.link_from(wf.start_point)
+    wf.end_point.link_from(c)
+    wf.initialize()
+    assert order.index("a") < order.index("b") < order.index("c")
+
+
+def test_deferred_initialize_retries():
+    wf = make_wf()
+
+    class Deferring(TrivialUnit):
+        tries = 0
+
+        def initialize(self, **kwargs):
+            Deferring.tries += 1
+            if Deferring.tries < 3:
+                return True
+            super().initialize(**kwargs)
+
+    d = Deferring(wf, name="d")
+    d.link_from(wf.start_point)
+    wf.end_point.link_from(d)
+    wf.initialize()
+    assert Deferring.tries == 3
+    assert d.is_initialized
+
+
+def test_demand_protocol():
+    wf = make_wf()
+    u = TrivialUnit(wf, demand=["needed"])
+    u.needed = None
+    u.link_from(wf.start_point)
+    with pytest.raises(ValueError):
+        wf.initialize()
+    u.needed = 5
+    wf.initialize()
+
+
+def test_workflow_checksum_stable_and_sensitive():
+    wf1 = make_wf()
+    a1 = CountingUnit(wf1, name="a")
+    a1.link_from(wf1.start_point)
+    wf1.end_point.link_from(a1)
+
+    wf2 = make_wf()
+    a2 = CountingUnit(wf2, name="a")
+    a2.link_from(wf2.start_point)
+    wf2.end_point.link_from(a2)
+
+    assert wf1.checksum == wf2.checksum
+    CountingUnit(wf2, name="extra")
+    assert wf1.checksum != wf2.checksum
+
+
+def test_generate_graph_dot():
+    wf = make_wf()
+    a = CountingUnit(wf, name="a")
+    a.link_from(wf.start_point)
+    wf.end_point.link_from(a)
+    dot = wf.generate_graph()
+    assert "digraph" in dot
+    assert '"Start" -> "a"' in dot
+
+
+def test_unit_timers_accumulate():
+    wf = make_wf()
+    a = CountingUnit(wf, name="a")
+    a.link_from(wf.start_point)
+    wf.end_point.link_from(a)
+    wf.initialize()
+    wf.run()
+    assert a.timers["runs"] == 1
+    assert a.timers["run"] >= 0
+
+
+def test_pickle_excludes_transient():
+    wf = make_wf()
+    a = CountingUnit(wf, name="a")
+    a.transient_ = object()
+    a.persistent = 5
+    state = a.__getstate__()
+    assert "transient_" not in state
+    assert state["persistent"] == 5
+
+
+def test_rerun_workflow():
+    wf = make_wf()
+    a = CountingUnit(wf, name="a")
+    a.link_from(wf.start_point)
+    wf.end_point.link_from(a)
+    wf.initialize()
+    wf.run()
+    wf.initialize()
+    wf.run()
+    assert a.counter == 2
+
+
+def test_stopped_suppresses_propagation_and_firestarter_revives():
+    from veles_tpu import FireStarter
+    wf = make_wf()
+    a = CountingUnit(wf, name="a")
+    b = CountingUnit(wf, name="b")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    wf.end_point.link_from(b)
+    a.stopped = True
+    wf.initialize()
+    wf.run()
+    assert a.counter == 1 and b.counter == 0  # propagation stopped at a
+    fs = FireStarter(wf, units=[a])
+    fs.run()
+    assert a.stopped is False
+    wf.run()
+    assert b.counter == 1
+
+
+def test_gate_block_does_not_latch_inputs():
+    wf = make_wf()
+    a = CountingUnit(wf, name="a")
+    b = CountingUnit(wf, name="b")
+    join = CountingUnit(wf, name="join")
+    a.link_from(wf.start_point)
+    b.link_from(wf.start_point)
+    join.link_from(a, b)
+    wf.end_point.link_from(join)
+    join.gate_block <<= True
+    wf.initialize()
+    wf.run()
+    assert join.counter == 0
+    assert not any(join.links_from.values())  # nothing latched while blocked

@@ -1,0 +1,20 @@
+"""veles_tpu — a TPU-native deep-learning platform.
+
+A brand-new framework with the capability surface of Samsung VELES
+(dataflow unit/workflow engine, config-driven standard NN workflows,
+full-batch and streaming loaders, reproducible RNG, snapshot/resume, elastic
+distributed training, GA hyperparameter optimization, ensembles,
+observability, REST serving, compiled export) built idiomatically on
+JAX/XLA/Pallas: units trace into jitted, donated, mesh-sharded step
+functions; datasets live as HBM-resident sharded arrays; gradients
+all-reduce over ICI via in-program collectives.
+"""
+
+__version__ = "0.1.0"
+
+from .config import root, Config, Range                     # noqa: F401
+from .mutable import Bool                                   # noqa: F401
+from .units import Unit, TrivialUnit, IDistributable        # noqa: F401
+from .workflow import Workflow, NoMoreJobs                  # noqa: F401
+from .plumbing import StartPoint, EndPoint, Repeater, FireStarter  # noqa: F401
+from .result_provider import IResultProvider                # noqa: F401

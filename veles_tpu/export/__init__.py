@@ -1,0 +1,10 @@
+"""Export path: trained workflow → portable archive for native inference.
+
+Plays the role of the reference ``Workflow.package_export``
+(/root/reference/veles/workflow.py:868-975), which the C++ libVeles runtime
+consumes.  Here the archive carries ``contents.json`` (graph + unit
+parameters), per-unit weight ``.npy`` files, and optionally a serialized
+StableHLO program (``jax.export``) for the compiled inference path.
+"""
+
+from .packager import package_export  # noqa: F401

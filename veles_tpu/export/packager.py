@@ -1,0 +1,86 @@
+"""Workflow archive writer.
+
+Produces the portable inference package (reference:
+veles/workflow.py:868-975 writes a zip/tgz of ``contents.json`` + fp16/fp32
+``.npy`` arrays for libVeles).  Layout here:
+
+- ``contents.json`` — workflow name, checksum, unit list in dependency order
+  with class/UUID/links and the names of exported arrays;
+- ``<unit>/<attr>.npy`` — each exported array, cast to fp16 or fp32;
+- optionally ``model.stablehlo`` — serialized jax.export artifact of the
+  compiled forward (added by the model layer when available).
+
+The C++ native runtime (``native/``) and
+:class:`veles_tpu.export.loader.PackageLoader` both consume this format.
+"""
+
+import json
+import os
+import tempfile
+import zipfile
+
+import numpy
+
+
+def _exported_arrays(unit):
+    out = {}
+    for attr in getattr(unit, "exports", ()):
+        value = getattr(unit, attr, None)
+        if value is None:
+            continue
+        mem = getattr(value, "mem", None)  # Array facade → host ndarray
+        arr = numpy.asarray(mem if mem is not None else value)
+        out[attr] = arr
+    return out
+
+
+def package_export(workflow, path, precision=32, extra_files=None):
+    """Write the workflow package archive to ``path`` (.zip).
+
+    ``precision`` ∈ {16, 32}: floating arrays are cast to float16/float32
+    (the reference's fp16/fp32 export switch).
+    """
+    if precision not in (16, 32):
+        raise ValueError("precision must be 16 or 32")
+    fdtype = numpy.float16 if precision == 16 else numpy.float32
+    units_desc = []
+    arrays = []  # (zip name, ndarray)
+    for unit in workflow:
+        desc = unit.describe()
+        exported = _exported_arrays(unit)
+        desc["arrays"] = {}
+        for attr, arr in exported.items():
+            if numpy.issubdtype(arr.dtype, numpy.floating):
+                arr = arr.astype(fdtype)
+            zname = "%s/%s.npy" % (unit.name.replace("/", "_"), attr)
+            desc["arrays"][attr] = {
+                "file": zname,
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+            }
+            arrays.append((zname, arr))
+        params = getattr(unit, "export_params", None)
+        if callable(params):
+            desc["params"] = params()
+        units_desc.append(desc)
+    contents = {
+        "workflow": workflow.name,
+        "checksum": workflow.checksum,
+        "precision": precision,
+        "units": units_desc,
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("contents.json", json.dumps(contents, indent=2,
+                                                default=str))
+        for zname, arr in arrays:
+            with tempfile.NamedTemporaryFile(suffix=".npy",
+                                             delete=False) as tmp:
+                numpy.save(tmp, arr)
+                tmpname = tmp.name
+            try:
+                zf.write(tmpname, zname)
+            finally:
+                os.unlink(tmpname)
+        for zname, data in (extra_files or {}).items():
+            zf.writestr(zname, data)
+    return path
