@@ -1,0 +1,141 @@
+"""Tests for the device/memory layer (patterned after the reference
+multi-backend tests, /root/reference/veles/tests/accelerated_test.py)."""
+
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device, CPUDevice, NumpyDevice, resolve_dtype
+from veles_tpu.memory import Array, Watcher
+from veles_tpu.accelerated_units import AcceleratedUnit, DeviceBenchmark
+from veles_tpu.prng import RandomGenerator, KeyTree, get
+from veles_tpu.workflow import Workflow
+
+
+def test_device_registry_dispatch():
+    assert isinstance(Device(backend="cpu"), CPUDevice)
+    assert isinstance(Device(backend="numpy"), NumpyDevice)
+    with pytest.raises(ValueError):
+        Device(backend="nope")
+
+
+def test_device_auto_and_benchmark():
+    dev = Device(backend="auto")
+    assert dev.backend_name in ("tpu", "cpu")
+    gflops = dev.benchmark(size=128, repeats=1)
+    assert gflops > 0
+
+
+def test_numpy_device():
+    dev = NumpyDevice()
+    assert not dev.exists
+    assert dev.benchmark(size=64) > 0
+
+
+def test_resolve_dtype():
+    assert resolve_dtype("float32") == numpy.float32
+    assert resolve_dtype("bfloat16").itemsize == 2
+
+
+def test_array_roundtrip():
+    a = Array(numpy.arange(12, dtype=numpy.float32).reshape(3, 4))
+    assert a.shape == (3, 4)
+    assert a.sample_size == 4
+    dm = a.devmem
+    assert dm is not None
+    # device copy reflects host data
+    assert numpy.allclose(numpy.asarray(dm), a.mem)
+    # host mutation via map_write then unmap re-uploads
+    a.map_write()[0, 0] = 99
+    a.unmap()
+    assert numpy.asarray(a.devmem)[0, 0] == 99
+
+
+def test_array_device_to_host():
+    import jax.numpy as jnp
+    a = Array(numpy.zeros((2, 2), numpy.float32))
+    a.devmem = jnp.ones((2, 2))
+    # device is newer; map_read pulls
+    assert a.map_read()[0, 0] == 1.0
+
+
+def test_array_watcher_accounting():
+    Watcher.reset()
+    a = Array(numpy.zeros(1024, numpy.float32))
+    _ = a.devmem
+    assert Watcher.bytes_in_use >= 4096
+    a.reset()
+    assert Watcher.bytes_in_use == 0
+
+
+def test_array_pickle_and_shallow():
+    a = Array(numpy.arange(4.0))
+    b = pickle.loads(pickle.dumps(a))
+    assert numpy.allclose(b.mem, a.mem)
+    a.shallow_pickle = True
+    c = pickle.loads(pickle.dumps(a))
+    assert c.mem is None
+
+
+def test_prng_reproducible():
+    g1 = RandomGenerator().seed(1234)
+    g2 = RandomGenerator().seed(1234)
+    assert numpy.allclose(g1.normal(size=8), g2.normal(size=8))
+    # state save/restore determinism (snapshot semantics)
+    state = pickle.dumps(g1)
+    x = g1.uniform(size=4)
+    g3 = pickle.loads(state)
+    assert numpy.allclose(g3.uniform(size=4), x)
+    assert get(0) is get(0)
+
+
+def test_key_tree_deterministic():
+    import jax
+    kt1, kt2 = KeyTree(7), KeyTree(7)
+    k1 = kt1.key_for("conv1")
+    k2 = kt2.key_for("conv1")
+    assert numpy.allclose(jax.random.uniform(k1, (4,)),
+                          jax.random.uniform(k2, (4,)))
+    # advancing produces a different stream
+    k3 = kt1.key_for("conv1")
+    assert not numpy.allclose(jax.random.uniform(k1, (4,)),
+                              jax.random.uniform(k3, (4,)))
+    # pickles with counters
+    kt4 = pickle.loads(pickle.dumps(kt1))
+    assert kt4.counters == kt1.counters
+
+
+class _Doubler(AcceleratedUnit):
+    """out = 2*x + 1 with device and numpy twins."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input = Array()
+        self.output = Array()
+        self.device_inputs = ["input"]
+        self.device_outputs = ["output"]
+
+    def kernel(self, x):
+        return 2 * x + 1
+
+    def numpy_run(self):
+        self.output.mem = 2 * self.input.map_read() + 1
+
+
+@pytest.mark.parametrize("backend", ["cpu", "numpy"])
+def test_accelerated_unit_parity(backend):
+    wf = Workflow(name="w")
+    u = _Doubler(wf)
+    u.input.mem = numpy.arange(6, dtype=numpy.float32).reshape(2, 3)
+    u.initialize(device=Device(backend=backend))
+    u.run()
+    assert numpy.allclose(u.output.map_read(),
+                          2 * u.input.mem + 1)
+
+
+def test_device_benchmark_unit():
+    wf = Workflow(name="w")
+    b = DeviceBenchmark(wf, size=128, repeats=1)
+    b.initialize(device=Device(backend="cpu"))
+    assert b.estimate() > 0

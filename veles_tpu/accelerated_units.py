@@ -1,0 +1,155 @@
+"""Accelerated units: graph nodes whose compute is a jitted JAX function.
+
+TPU-native re-design of /root/reference/veles/accelerated_units.py
+(AcceleratedUnit :130 — per-backend init/run dispatch, Jinja2 kernel source
+generation :509-565, tar.gz binary cache :605-673; AcceleratedWorkflow :827).
+
+The reference compiles `.cl`/`.cu` sources per device and dispatches
+`ocl_run`/`cuda_run`/`numpy_run`.  Here the "kernel" is a **pure function**
+over arrays; `tpu_init` jits it (XLA's persistent compilation cache replaces
+the tar.gz binary cache), `numpy_run` stays as the parity twin the test
+strategy is built on (reference tests/accelerated_test.py:79).  The method
+resolution mirrors the reference's ``assign_backend_methods`` trick
+(backends.py:244-262): `initialize` binds `_backend_run_` to `tpu_run` or
+`numpy_run` depending on the Device.
+
+The `--sync-run` equivalent (`root.common.engine.sync_run`) calls
+``block_until_ready`` after every unit for honest per-unit timings
+(reference accelerated_units.py:292-295).
+"""
+
+import numpy
+
+from .backends import Device, NumpyDevice
+from .config import root
+from .memory import Array
+from .units import Unit
+
+
+class AcceleratedUnit(Unit):
+    """A unit with a jitted device path and a numpy parity path.
+
+    Subclasses implement:
+
+    - ``kernel(self, *arrays) -> arrays`` — a **pure** function of jax arrays
+      (closed over static config only), jitted once at initialize;
+    - ``numpy_run(self)`` — the host twin mutating Arrays in place;
+    - optionally ``tpu_run(self)`` when the default "gather inputs → kernel →
+      scatter outputs" protocol does not fit.
+
+    Declare device I/O with ``self.device_inputs = ["input", ...]`` and
+    ``self.device_outputs = ["output", ...]`` (attribute names holding
+    :class:`~veles_tpu.memory.Array`).
+    """
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.device = None
+        self.device_inputs = []
+        self.device_outputs = []
+        self.intermediates = []  # Arrays to unmap before running
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(**kwargs)
+        if device is None:
+            device = Device(backend="auto")
+        self.device = device
+        force_numpy = bool(root.common.engine.get("force_numpy", False))
+        if isinstance(device, NumpyDevice) or force_numpy or not device.exists:
+            self._backend_run_ = self.numpy_run
+            self.numpy_init()
+        else:
+            self._backend_run_ = self.tpu_run
+            self.tpu_init()
+
+    # -- per-backend hooks ---------------------------------------------------
+    def numpy_init(self):
+        pass
+
+    def tpu_init(self):
+        """Build the jitted kernel.  Default: jit ``self.kernel``."""
+        import jax
+        if hasattr(self, "kernel"):
+            self._jitted_ = jax.jit(self.kernel)
+
+    def kernel(self, *arrays):  # pragma: no cover - interface doc
+        raise NotImplementedError
+
+    def numpy_run(self):
+        raise NotImplementedError(
+            "%s has no numpy twin" % type(self).__name__)
+
+    def tpu_run(self):
+        """Gather declared inputs, run the jitted kernel, store outputs."""
+        ins = []
+        for name in self.device_inputs:
+            arr = getattr(self, name)
+            ins.append(arr.devmem if isinstance(arr, Array) else arr)
+        outs = self._jitted_(*ins)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        for name, val in zip(self.device_outputs, outs):
+            arr = getattr(self, name)
+            if isinstance(arr, Array):
+                arr.devmem = val
+            else:
+                setattr(self, name, val)
+
+    # -- run dispatch --------------------------------------------------------
+    def run(self):
+        self._backend_run_()
+        if bool(root.common.engine.get("sync_run", False)):
+            self.device.sync()
+
+    def unmap_vectors(self, *arrays):
+        """Push host-dirty Arrays to the device before kernel launch
+        (reference accelerated_units.py:448)."""
+        for arr in arrays:
+            if isinstance(arr, Array):
+                arr.unmap()
+
+
+class DeviceBenchmark(AcceleratedUnit):
+    """Square-GEMM timing probe; the "computing power" number used for
+    slave load balancing (reference accelerated_units.py:706-824)."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.size = kwargs.get("size", 1024)
+        self.repeats = kwargs.get("repeats", 4)
+        self.result = None
+
+    def tpu_init(self):
+        pass
+
+    def tpu_run(self):
+        self.result = self.device.benchmark(self.size, repeats=self.repeats)
+
+    def numpy_run(self):
+        dev = self.device if isinstance(self.device, NumpyDevice) \
+            else NumpyDevice()
+        self.result = dev.benchmark(min(self.size, 512))
+
+    def estimate(self):
+        if self.result is None:
+            self.run()
+        return self.result
+
+
+class AcceleratedWorkflow(object):
+    """Mixin for workflows holding a Device (reference
+    accelerated_units.py:827-900); the Device travels to member units via
+    Workflow.initialize(device=...)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.device = None
+
+
+def numpy_to_device(x, dtype=None):
+    """Convenience device_put with optional dtype cast."""
+    import jax
+    x = numpy.asarray(x, dtype) if dtype else numpy.asarray(x)
+    return jax.device_put(x)

@@ -1,0 +1,251 @@
+"""Device backends: the layer that binds the unit graph to hardware.
+
+TPU-native re-design of /root/reference/veles/backends.py (Device base +
+BackendRegistry :166-197, OpenCLDevice :426, CUDADevice :745, NumpyDevice
+:918, AutoDevice :406).  The reference selects an OpenCL/CUDA context and
+hands units raw queues; here a Device owns a set of JAX devices and a
+:class:`jax.sharding.Mesh`, and hands units jit/compile services instead of
+command queues.  The reference's per-device autotune database
+(``device_infos.json``, backends.py:623-731) is unnecessary: XLA autotunes
+tiling for the MXU at compile time, and the persistent compilation cache
+plays the role of the kernel binary cache.
+
+Backend names: ``tpu``, ``cpu`` (JAX cpu — the multi-device virtual mesh in
+tests), ``numpy`` (pure-numpy pseudo-device for parity tests), ``auto``.
+Selection precedence mirrors the reference (-a flag > env > auto,
+backends.py:184-197): explicit name > $VELES_BACKEND > auto.
+"""
+
+import os
+import threading
+import time
+
+import numpy
+
+from .config import root
+
+
+class BackendRegistry(type):
+    """Metaclass registering Device subclasses by their ``BACKEND`` name
+    (reference backends.py:166-181)."""
+
+    backends = {}
+
+    def __init__(cls, name, bases, clsdict):
+        super().__init__(name, bases, clsdict)
+        backend = clsdict.get("BACKEND")
+        if backend is not None:
+            BackendRegistry.backends[backend] = cls
+
+
+class Device(metaclass=BackendRegistry):
+    """Base device.  ``Device(backend="tpu")`` dispatches to the registered
+    subclass the way the reference's ``__new__`` trick does
+    (backends.py:190-197)."""
+
+    BACKEND = None
+
+    def __new__(cls, *args, **kwargs):
+        if cls is not Device:
+            return super().__new__(cls)
+        backend = kwargs.get("backend") or os.environ.get(
+            "VELES_BACKEND", root.common.engine.get("backend", "auto"))
+        if backend == "auto":
+            backend = AutoDevice.pick()
+        try:
+            impl = BackendRegistry.backends[backend]
+        except KeyError:
+            raise ValueError(
+                "unknown backend %r (have: %s)" %
+                (backend, ", ".join(sorted(BackendRegistry.backends))))
+        return super().__new__(impl)
+
+    def __init__(self, **kwargs):
+        self._compute_power = None
+        self._lock = threading.Lock()
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def backend_name(self):
+        return self.BACKEND
+
+    @property
+    def is_attached(self):
+        return True
+
+    def __repr__(self):
+        return "<%s>" % type(self).__name__
+
+    # -- services ------------------------------------------------------------
+    @property
+    def jax_devices(self):
+        """The JAX devices this Device drives (empty for numpy)."""
+        return []
+
+    @property
+    def default_jax_device(self):
+        devs = self.jax_devices
+        return devs[0] if devs else None
+
+    def sync(self):
+        """Barrier until all dispatched work completes (reference
+        device.sync(); CUDA ctx sync / OCL queue finish)."""
+
+    def memory_stats(self):
+        """Bytes in use / limit on the first device, when the platform
+        reports them (reference Watcher accounting, memory.py:56-107)."""
+        return {}
+
+    @property
+    def compute_power(self):
+        """GFLOPS-ish rating used for load balancing (reference
+        DeviceBenchmark "points", accelerated_units.py:843-858)."""
+        if self._compute_power is None:
+            self._compute_power = self.benchmark()
+        return self._compute_power
+
+    def benchmark(self, size=1024, dtype=None, repeats=4):
+        raise NotImplementedError
+
+    @property
+    def exists(self):
+        """False only for the numpy pseudo-device (reference
+        backends.py:918)."""
+        return True
+
+
+class _JaxDevice(Device):
+    """Shared implementation for JAX-backed devices."""
+
+    PLATFORM = None
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        import jax
+        self._jax = jax
+        try:
+            self._devices = jax.devices(self.PLATFORM)
+        except RuntimeError as e:
+            raise RuntimeError(
+                "no %s devices visible to JAX: %s" % (self.PLATFORM, e))
+
+    @property
+    def jax_devices(self):
+        return list(self._devices)
+
+    def sync(self):
+        # A tiny transfer to each device acts as the queue barrier.
+        import jax
+        for d in self._devices:
+            jax.device_put(0, d).block_until_ready()
+
+    def memory_stats(self):
+        try:
+            stats = self._devices[0].memory_stats()
+        except Exception:
+            return {}
+        return stats or {}
+
+    def benchmark(self, size=1024, dtype=None, repeats=4):
+        """Time a square matmul; returns achieved GFLOP/s.  Plays the role
+        of the reference DeviceBenchmark (accelerated_units.py:706-824)."""
+        import jax
+        import jax.numpy as jnp
+        dtype = dtype or jnp.bfloat16
+        a = jax.device_put(jnp.ones((size, size), dtype), self._devices[0])
+        f = jax.jit(lambda x: x @ x)
+        f(a).block_until_ready()  # compile outside the timed region
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            r = f(a)
+        r.block_until_ready()
+        dt = (time.perf_counter() - t0) / repeats
+        return 2.0 * size ** 3 / dt / 1e9
+
+
+class TPUDevice(_JaxDevice):
+    """The flagship backend: JAX TPU devices over PJRT."""
+
+    BACKEND = "tpu"
+    PLATFORM = None  # default platform = accelerator if present
+
+    def __init__(self, **kwargs):
+        import jax
+        # accept whatever the default accelerator platform is (tpu, or the
+        # tunneled single-chip "axon" platform in the build environment)
+        self.PLATFORM = None
+        super().__init__(**kwargs)
+        self._devices = jax.devices()
+
+
+class CPUDevice(_JaxDevice):
+    """JAX CPU backend — used by tests as a virtual multi-device mesh
+    (XLA_FLAGS=--xla_force_host_platform_device_count=N)."""
+
+    BACKEND = "cpu"
+    PLATFORM = "cpu"
+
+
+class NumpyDevice(Device):
+    """Pure-numpy pseudo-device: the parity-test twin (reference
+    backends.py:918-949).  Units run their ``numpy_run`` path against it."""
+
+    BACKEND = "numpy"
+
+    @property
+    def exists(self):
+        return False
+
+    def sync(self):
+        pass
+
+    def benchmark(self, size=512, dtype=numpy.float32, repeats=2):
+        a = numpy.ones((size, size), dtype)
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            a @ a
+        dt = (time.perf_counter() - t0) / repeats
+        return 2.0 * size ** 3 / dt / 1e9
+
+
+class AutoDevice(Device):
+    """Backend auto-selection (reference backends.py:406-423)."""
+
+    BACKEND = "auto"
+
+    @staticmethod
+    def pick():
+        import jax
+        try:
+            platform = jax.default_backend()
+        except Exception:
+            return "numpy"
+        return "cpu" if platform == "cpu" else "tpu"
+
+    def __new__(cls, *args, **kwargs):
+        return Device(backend=AutoDevice.pick(), **kwargs)
+
+
+# -- dtype table (reference veles/opencl_types.py:39-77) ----------------------
+#: mapping of the config-level dtype names onto numpy/jax dtypes
+dtype_map = {
+    "float16": numpy.float16,
+    "bfloat16": "bfloat16",   # resolved lazily through ml_dtypes via jnp
+    "float32": numpy.float32,
+    "float64": numpy.float64,
+    "int8": numpy.int8,
+    "int16": numpy.int16,
+    "int32": numpy.int32,
+    "int64": numpy.int64,
+    "uint8": numpy.uint8,
+}
+
+
+def resolve_dtype(name=None):
+    """Config dtype name -> numpy dtype object (jnp understands all)."""
+    name = name or root.common.engine.get("precision_type", "float32")
+    dt = dtype_map[name]
+    if dt == "bfloat16":
+        import ml_dtypes
+        return numpy.dtype(ml_dtypes.bfloat16)
+    return numpy.dtype(dt)

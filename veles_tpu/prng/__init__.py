@@ -1,0 +1,7 @@
+"""Reproducible random number generation.
+
+Reference: /root/reference/veles/prng/ (RandomGenerator at
+random_generator.py:64, keyed global instances via ``prng.get(n)``).
+"""
+
+from .random_generator import RandomGenerator, get, KeyTree  # noqa: F401

@@ -1,0 +1,131 @@
+"""Seeded random generators with snapshot-safe state.
+
+Re-design of /root/reference/veles/prng/random_generator.py:64 (numpy
+RandomState wrapper with state save/restore and global keyed instances) plus
+a TPU-side answer to the reference's device xorshift1024* unit
+(cuda/random.cu:40-60): stateless :mod:`jax.random` keys derived from a
+:class:`KeyTree`, so every unit's device randomness is a pure function of
+(seed, unit name, step counter) — reproducible across restarts and shardings
+without device-side state, which is the JAX-idiomatic replacement for
+replaying RandomState per unit (reference units.py:859-885).
+"""
+
+import threading
+
+import numpy
+
+
+class RandomGenerator:
+    """Deterministic numpy generator with pickle-able state."""
+
+    def __init__(self, key=None):
+        self.key = key
+        self._state = numpy.random.RandomState()
+        self._seed_value = None
+
+    def seed(self, seed, dtype=None, count=None):
+        """Seed from an int, bytes, or an array (the reference accepts raw
+        seed files and hex strings, __main__.py:483-539)."""
+        if isinstance(seed, (bytes, bytearray)):
+            seed = numpy.frombuffer(seed, dtype=numpy.uint32)
+        if isinstance(seed, numpy.ndarray):
+            seed = int(numpy.bitwise_xor.reduce(seed.view(numpy.uint32)))
+        self._seed_value = int(seed) & 0xFFFFFFFF
+        self._state = numpy.random.RandomState(self._seed_value)
+        return self
+
+    @property
+    def seed_value(self):
+        return self._seed_value
+
+    # numpy-compatible sampling surface -------------------------------------
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        return self._state.normal(loc, scale, size)
+
+    def uniform(self, low=0.0, high=1.0, size=None):
+        return self._state.uniform(low, high, size)
+
+    def randint(self, low, high=None, size=None, dtype=int):
+        return self._state.randint(low, high, size, dtype)
+
+    def shuffle(self, arr):
+        self._state.shuffle(arr)
+
+    def permutation(self, n):
+        return self._state.permutation(n)
+
+    def choice(self, a, size=None, replace=True, p=None):
+        return self._state.choice(a, size, replace, p)
+
+    def bytes(self, n):
+        return self._state.bytes(n)
+
+    def fill(self, arr, vmin=-1.0, vmax=1.0):
+        """In-place uniform fill (reference RandomGenerator.fill)."""
+        arr[...] = self._state.uniform(vmin, vmax, arr.shape).astype(
+            arr.dtype)
+
+    # state save/restore (snapshot determinism) ------------------------------
+    @property
+    def state(self):
+        return self._state.get_state()
+
+    @state.setter
+    def state(self, value):
+        self._state.set_state(value)
+
+    def __getstate__(self):
+        return {"key": self.key, "seed": self._seed_value,
+                "state": self._state.get_state()}
+
+    def __setstate__(self, state):
+        self.key = state["key"]
+        self._seed_value = state["seed"]
+        self._state = numpy.random.RandomState()
+        self._state.set_state(state["state"])
+
+
+_lock = threading.Lock()
+_generators = {}
+
+
+def get(key=0):
+    """Global keyed generator instances (reference ``prng.get(n)``)."""
+    with _lock:
+        gen = _generators.get(key)
+        if gen is None:
+            import zlib
+            gen = _generators[key] = RandomGenerator(key)
+            gen.seed(42 + (key if isinstance(key, int)
+                           else zlib.crc32(str(key).encode())))
+        return gen
+
+
+class KeyTree:
+    """Stateless JAX PRNG keys for units: key = fold_in(root, name, step).
+
+    The per-unit step counters are plain ints, so they pickle with the
+    workflow snapshot and restore deterministic randomness on resume.
+    """
+
+    def __init__(self, seed=42):
+        self.seed = int(seed)
+        self.counters = {}
+
+    def key_for(self, name, advance=True):
+        import jax
+        import zlib
+        c = self.counters.get(name, 0)
+        if advance:
+            self.counters[name] = c + 1
+        key = jax.random.key(self.seed)
+        key = jax.random.fold_in(
+            key, zlib.crc32(str(name).encode()) & 0x7FFFFFFF)
+        return jax.random.fold_in(key, c)
+
+    def __getstate__(self):
+        return {"seed": self.seed, "counters": dict(self.counters)}
+
+    def __setstate__(self, state):
+        self.seed = state["seed"]
+        self.counters = state["counters"]
