@@ -1,0 +1,147 @@
+"""Loader protocol tests (patterned after the reference test_loader.py):
+epoch/flags accounting, shuffling reproducibility, master/slave index
+distribution, failed-minibatch requeue, device-vs-numpy gather parity."""
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.loader import (FullBatchLoader, FullBatchLoaderMSE,
+                              TRAIN, VALID, TEST)
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.workflow import Workflow
+
+
+class SyntheticLoader(FullBatchLoader):
+    """60 train / 20 valid / 10 test samples of 8 features, 4 classes."""
+
+    def load_data(self):
+        rng = numpy.random.RandomState(7)
+        n = 90
+        self.original_data.mem = rng.uniform(-1, 1, (n, 8)).astype(
+            numpy.float32)
+        self.original_labels = list(rng.randint(0, 4, n))
+        self.class_lengths[TEST] = 10
+        self.class_lengths[VALID] = 20
+        self.class_lengths[TRAIN] = 60
+
+
+def make_loader(backend="numpy", **kwargs):
+    wf = Workflow(name="w")
+    kwargs.setdefault("minibatch_size", 16)
+    kwargs.setdefault("prng", RandomGenerator().seed(123))
+    loader = SyntheticLoader(wf, **kwargs)
+    loader.initialize(device=Device(backend=backend))
+    return loader
+
+
+def run_epoch(loader):
+    batches = []
+    while True:
+        loader.run()
+        batches.append((loader.minibatch_class, loader.minibatch_size))
+        if loader.epoch_ended:
+            break
+    return batches
+
+
+def test_epoch_structure():
+    loader = make_loader()
+    batches = run_epoch(loader)
+    # classes served in test, valid, train order; sizes sum to totals
+    sizes = {TEST: 0, VALID: 0, TRAIN: 0}
+    for cls, size in batches:
+        sizes[cls] += size
+    assert sizes == {TEST: 10, VALID: 20, TRAIN: 60}
+    assert loader.epoch_number == 0
+    loader.run()  # first minibatch of next epoch
+    assert loader.epoch_number == 1
+    assert not bool(loader.epoch_ended)
+
+
+def test_minibatch_never_spans_classes():
+    loader = make_loader()
+    for _ in range(40):
+        loader.run()
+        start = loader.minibatch_offset - loader.minibatch_size
+        cls_of_start = loader.class_of_offset(start + 1)
+        assert cls_of_start == loader.minibatch_class
+
+
+def test_shuffle_only_train_segment():
+    loader = make_loader()
+    run_epoch(loader)
+    loader.run()  # triggers epoch wrap + shuffle
+    idx = numpy.asarray(loader.shuffled_indices.mem)
+    assert list(idx[:30]) == list(range(30))  # test+valid untouched
+    assert set(idx[30:]) == set(range(30, 90))
+    assert list(idx[30:]) != list(range(30, 90))  # train shuffled
+
+
+def test_device_numpy_gather_parity():
+    dev_loader = make_loader(backend="cpu")
+    np_loader = make_loader(backend="numpy")
+    for _ in range(10):
+        dev_loader.run()
+        np_loader.run()
+        n = np_loader.minibatch_size
+        # padding rows beyond minibatch_size differ by design (device pads
+        # with a repeated valid row; consumers mask on minibatch_size)
+        assert numpy.allclose(dev_loader.minibatch_data.map_read()[:n],
+                              np_loader.minibatch_data.map_read()[:n])
+        assert numpy.array_equal(
+            dev_loader.minibatch_labels.map_read()[:n],
+            np_loader.minibatch_labels.map_read()[:n])
+
+
+def test_normalized_loader():
+    loader = make_loader(normalization_type="mean_disp")
+    train = numpy.asarray(loader.original_data.mem[30:])
+    assert abs(train.mean()) < 0.2  # roughly centered by train stats
+
+
+def test_master_slave_index_distribution():
+    master = make_loader()
+    slave = make_loader()
+    job = master.generate_data_for_slave(slave="s1")
+    slave.apply_data_from_master(job)
+    n = slave.minibatch_size
+    assert n == job["minibatch_size"]
+    expect = slave.original_data.mem[job["indices"]]
+    assert numpy.allclose(slave.minibatch_data.map_read()[:n], expect)
+    master.apply_data_from_slave(True, slave="s1")
+    assert master.samples_served == n
+
+
+def test_failed_minibatch_requeue():
+    master = make_loader()
+    job = master.generate_data_for_slave(slave="s1")
+    master.drop_slave(slave="s1")
+    assert master.failed_minibatches
+    job2 = master.generate_data_for_slave(slave="s2")
+    assert job2["minibatch_offset"] == job["minibatch_offset"]
+
+
+class SyntheticMSELoader(FullBatchLoaderMSE):
+    def load_data(self):
+        rng = numpy.random.RandomState(3)
+        self.original_data.mem = rng.uniform(-1, 1, (40, 6)).astype(
+            numpy.float32)
+        self.original_targets.mem = rng.uniform(-1, 1, (40, 3)).astype(
+            numpy.float32)
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = 10
+        self.class_lengths[TRAIN] = 30
+
+
+@pytest.mark.parametrize("backend", ["cpu", "numpy"])
+def test_mse_loader(backend):
+    wf = Workflow(name="w")
+    loader = SyntheticMSELoader(wf, minibatch_size=8,
+                                prng=RandomGenerator().seed(5))
+    loader.initialize(device=Device(backend=backend))
+    loader.run()
+    n = loader.minibatch_size
+    idx = loader.minibatch_indices.map_read()[:n]
+    assert numpy.allclose(loader.minibatch_targets.map_read()[:n],
+                          loader.original_targets.mem[idx])

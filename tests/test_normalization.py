@@ -1,0 +1,105 @@
+"""Normalizer analyze/normalize/denormalize round-trips (patterned after
+the reference test_normalization.py)."""
+
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu import normalization
+
+
+def _data():
+    rng = numpy.random.RandomState(0)
+    return rng.uniform(-5, 9, (32, 7)).astype(numpy.float64)
+
+
+@pytest.mark.parametrize("name", ["mean_disp", "pointwise", "internal_mean",
+                                  "range_linear"])
+def test_stateful_roundtrip(name):
+    norm = normalization.factory(name)
+    data = _data()
+    for chunk in numpy.split(data, 4):
+        norm.analyze(chunk)
+    work = data.copy()
+    norm.normalize(work)
+    assert not numpy.allclose(work, data)
+    norm.denormalize(work)
+    assert numpy.allclose(work, data, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["mean_disp", "pointwise", "internal_mean",
+                                  "range_linear"])
+def test_jax_apply_matches_numpy(name):
+    norm = normalization.factory(name)
+    data = _data().astype(numpy.float32)
+    norm.analyze(data)
+    work = data.copy()
+    norm.normalize(work)
+    fused = numpy.asarray(norm.jax_apply(data))
+    assert numpy.allclose(fused, work, atol=1e-5)
+
+
+def test_none_normalizer():
+    norm = normalization.factory("none")
+    data = _data()
+    norm.analyze(data)
+    assert norm.normalize(data) is data
+
+
+def test_exp_normalizer_is_softmax():
+    norm = normalization.factory("exp")
+    data = _data().astype(numpy.float32)
+    work = data.copy()
+    norm.analyze(work)
+    norm.normalize(work)
+    assert numpy.allclose(work.sum(axis=1), 1.0, atol=1e-5)
+    fused = numpy.asarray(norm.jax_apply(data))
+    assert numpy.allclose(fused, work, atol=1e-5)
+
+
+def test_linear_normalizer_samplewise():
+    norm = normalization.factory("linear", interval=(0, 1))
+    data = _data().astype(numpy.float32)
+    work = data.copy()
+    norm.normalize(work)
+    assert numpy.allclose(work.min(axis=1), 0, atol=1e-6)
+    assert numpy.allclose(work.max(axis=1), 1, atol=1e-6)
+    fused = numpy.asarray(norm.jax_apply(data))
+    assert numpy.allclose(fused, work, atol=1e-5)
+
+
+def test_linear_uniform_sample_maps_to_midpoint():
+    norm = normalization.factory("linear", interval=(-1, 1))
+    data = numpy.ones((2, 4), numpy.float32)
+    data[1] = [0, 1, 2, 3]
+    norm.normalize(data)
+    assert numpy.allclose(data[0], 0.0)
+
+
+def test_external_mean():
+    mean = numpy.full(7, 2.0)
+    norm = normalization.factory("external_mean", mean_source=mean, scale=0.5)
+    data = _data()
+    work = data.copy()
+    norm.analyze(work)
+    norm.normalize(work)
+    assert numpy.allclose(work, (data - 2.0) * 0.5)
+    norm.denormalize(work)
+    assert numpy.allclose(work, data)
+
+
+def test_state_pickles_into_snapshot():
+    norm = normalization.factory("mean_disp")
+    data = _data()
+    norm.analyze(data)
+    restored = pickle.loads(pickle.dumps(norm))
+    a, b = data.copy(), data.copy()
+    norm.normalize(a)
+    restored.normalize(b)
+    assert numpy.allclose(a, b)
+    # state property reconstructs a working normalizer too
+    rebuilt = normalization.MeanDispersionNormalizer(state=norm.state)
+    c = data.copy()
+    rebuilt.normalize(c)
+    assert numpy.allclose(a, c)

@@ -71,7 +71,7 @@ class AcceleratedUnit(Unit):
     def tpu_init(self):
         """Build the jitted kernel.  Default: jit ``self.kernel``."""
         import jax
-        if hasattr(self, "kernel"):
+        if type(self).kernel is not AcceleratedUnit.kernel:
             self._jitted_ = jax.jit(self.kernel)
 
     def kernel(self, *arrays):  # pragma: no cover - interface doc
@@ -90,6 +90,11 @@ class AcceleratedUnit(Unit):
         outs = self._jitted_(*ins)
         if not isinstance(outs, (tuple, list)):
             outs = (outs,)
+        if len(outs) != len(self.device_outputs):
+            raise ValueError(
+                "%s.kernel returned %d outputs but device_outputs declares "
+                "%d" % (type(self).__name__, len(outs),
+                        len(self.device_outputs)))
         for name, val in zip(self.device_outputs, outs):
             arr = getattr(self, name)
             if isinstance(arr, Array):

@@ -164,18 +164,26 @@ class _JaxDevice(Device):
 
 
 class TPUDevice(_JaxDevice):
-    """The flagship backend: JAX TPU devices over PJRT."""
+    """The flagship backend: JAX TPU devices over PJRT.
+
+    Accepts whatever the default accelerator platform is (``tpu``, or the
+    tunneled single-chip ``axon`` platform in the build environment) but
+    refuses to run on a CPU-only host — an explicit ``tpu`` request must not
+    silently degrade (the reference raises on a missing CUDA/OCL device,
+    backends.py:452-467).
+    """
 
     BACKEND = "tpu"
-    PLATFORM = None  # default platform = accelerator if present
+    PLATFORM = None  # resolved to the default accelerator platform
 
     def __init__(self, **kwargs):
         import jax
-        # accept whatever the default accelerator platform is (tpu, or the
-        # tunneled single-chip "axon" platform in the build environment)
-        self.PLATFORM = None
         super().__init__(**kwargs)
         self._devices = jax.devices()
+        if self._devices and self._devices[0].platform == "cpu":
+            raise RuntimeError(
+                "backend 'tpu' requested but JAX only sees CPU devices; "
+                "use backend='cpu' explicitly for the virtual mesh")
 
 
 class CPUDevice(_JaxDevice):
@@ -243,7 +251,7 @@ dtype_map = {
 
 def resolve_dtype(name=None):
     """Config dtype name -> numpy dtype object (jnp understands all)."""
-    name = name or root.common.engine.get("precision_type", "float32")
+    name = name or root.common.engine.get("dtype", "float32")
     dt = dtype_map[name]
     if dt == "bfloat16":
         import ml_dtypes

@@ -1,0 +1,8 @@
+"""Data layer: minibatch loaders.
+
+Reference: /root/reference/veles/loader/ (base protocol at base.py:100-120).
+"""
+
+from .base import (Loader, LoaderError, TEST, VALID, TRAIN, CLASS_NAME,
+                   TRIAGE)                                  # noqa: F401
+from .fullbatch import FullBatchLoader, FullBatchLoaderMSE  # noqa: F401

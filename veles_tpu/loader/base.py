@@ -1,0 +1,337 @@
+"""Loader: the minibatch-serving unit at the head of every training loop.
+
+TPU-native re-design of /root/reference/veles/loader/base.py (Loader
+:100-120; TEST/VALID/TRAIN triage :73-80; master/slave index distribution
+:631-663; shuffling :711-724; failed-minibatch requeue :679-687;
+normalization analysis pass :760-800).
+
+Epoch model kept intact: the dataset is three classes laid out
+``[test | validation | train]``; a global offset walks the concatenated
+``shuffled_indices`` and the minibatch class is the segment the offset falls
+in.  ``last_minibatch``/``epoch_ended``/``train_ended`` are :class:`Bool`
+gates that downstream Decision units link on.  In distributed mode the
+master serves *indices only* and slaves gather their own data — the same
+contract the mesh data-parallel input pipeline uses per shard.
+"""
+
+import collections
+
+import numpy
+
+from ..config import root
+from ..memory import Array
+from ..mutable import Bool
+from ..units import Unit
+from ..result_provider import IResultProvider
+from .. import prng
+from .. import normalization
+
+TARGET = 3
+TRAIN = 2
+VALID = 1
+TEST = 0
+TRIAGE = {"train": TRAIN, "validation": VALID, "valid": VALID, "test": TEST}
+CLASS_NAME = ["test", "validation", "train"]
+
+
+class LoaderError(Exception):
+    pass
+
+
+class Loader(Unit, IResultProvider):
+    """Serves minibatches from a 3-class dataset.
+
+    Subclasses implement the ILoader trio (reference base.py:100-120):
+
+    - ``load_data()`` — fill ``class_lengths``;
+    - ``create_minibatch_data()`` — allocate ``minibatch_data``;
+    - ``fill_minibatch()`` — gather ``minibatch_data``/``minibatch_labels``
+      for ``minibatch_indices[:minibatch_size]``.
+
+    A subclass may instead override ``fill_indices`` to return True, meaning
+    the gather happens on-device (FullBatchLoader's jnp.take path).
+    """
+
+    LABEL_DTYPE = numpy.int32
+    INDEX_DTYPE = numpy.int32
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "LOADER"
+        self.max_minibatch_size = kwargs.get("minibatch_size", 100)
+        self.class_lengths = [0, 0, 0]
+        self.class_end_offsets = [0, 0, 0]
+        self.minibatch_data = Array()
+        self.minibatch_labels = Array()
+        self.minibatch_indices = Array()
+        self.minibatch_size = 0
+        self.minibatch_offset = 0
+        self.minibatch_class = TRAIN
+        self.last_minibatch = Bool(False)
+        self.epoch_ended = Bool(False)
+        self.train_ended = Bool(False)
+        self.epoch_number = 0
+        self.samples_served = 0
+        self.shuffled_indices = Array()
+        self.shuffle_limit = kwargs.get(
+            "shuffle_limit", numpy.iinfo(numpy.uint32).max)
+        self.prng = kwargs.get("prng", prng.get())
+        self.normalizer = normalization.factory(
+            kwargs.get("normalization_type", "none"),
+            **kwargs.get("normalization_parameters", {}))
+        self.train_ratio = kwargs.get("train_ratio", 1.0)
+        self.has_labels = True
+        self.labels_mapping = {}
+        self.raw_minibatch_labels = []
+        self._global_offset = 0
+        self.failed_minibatches = []
+        self.testing = bool(kwargs.get("testing", False))
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self.pending_minibatches_ = collections.defaultdict(list)
+
+    # -- derived sizes -------------------------------------------------------
+    @property
+    def total_samples(self):
+        return sum(self.class_lengths)
+
+    @property
+    def effective_train_length(self):
+        return int(self.class_lengths[TRAIN] * self.train_ratio)
+
+    @property
+    def effective_total(self):
+        return (self.class_lengths[TEST] + self.class_lengths[VALID] +
+                self.effective_train_length)
+
+    def class_of_offset(self, offset):
+        """Which class the (1-based end) offset falls in."""
+        for cls in (TEST, VALID, TRAIN):
+            if offset <= self.class_end_offsets[cls] and \
+                    self.class_lengths[cls]:
+                return cls
+        return TRAIN
+
+    # -- ILoader interface ---------------------------------------------------
+    def load_data(self):
+        raise NotImplementedError
+
+    def create_minibatch_data(self):
+        raise NotImplementedError
+
+    def fill_minibatch(self):
+        raise NotImplementedError
+
+    def fill_indices(self, start_offset, count):
+        """Copy shuffled indices into minibatch_indices; return True when
+        the data gather is device-side (reference base.py:736-744)."""
+        self.minibatch_indices.map_write()[:count] = \
+            self.shuffled_indices[start_offset:start_offset + count]
+        return False
+
+    # -- lifecycle -----------------------------------------------------------
+    def initialize(self, **kwargs):
+        super().initialize(**kwargs)
+        self.load_data()
+        if sum(self.class_lengths) == 0:
+            raise LoaderError("empty dataset")
+        offset = 0
+        for cls in (TEST, VALID, TRAIN):
+            offset += self.class_lengths[cls]
+            self.class_end_offsets[cls] = offset
+        self.max_minibatch_size = min(self.max_minibatch_size,
+                                      max(self.class_lengths))
+        self.minibatch_labels.reset(
+            numpy.zeros(self.max_minibatch_size, self.LABEL_DTYPE)
+            if self.has_labels else None)
+        self.minibatch_indices.reset(
+            numpy.zeros(self.max_minibatch_size, self.INDEX_DTYPE))
+        self.raw_minibatch_labels = [None] * self.max_minibatch_size
+        self.create_minibatch_data()
+        if not self.minibatch_data:
+            raise LoaderError(
+                "minibatch_data MUST be initialized in "
+                "create_minibatch_data()")
+        self.analyze_dataset()
+        if not getattr(self.workflow, "restored_from_snapshot", False) \
+                or self.testing:
+            self.shuffle()
+        self._global_offset = 0
+
+    def run(self):
+        """Serve one minibatch (standalone mode)."""
+        self.pending_minibatches_.pop(None, None)
+        self.serve_next_minibatch(None)
+        self._on_successful_serve()
+
+    # -- serving -------------------------------------------------------------
+    def shuffle(self):
+        """Shuffle the train segment only (reference base.py:711-724)."""
+        if not self.shuffled_indices:
+            self.shuffled_indices.mem = numpy.arange(
+                self.total_samples, dtype=self.INDEX_DTYPE)
+        if self.shuffle_limit <= 0 or self.class_lengths[TRAIN] == 0:
+            return
+        self.shuffle_limit -= 1
+        self.prng.shuffle(
+            self.shuffled_indices.map_write()[self.class_end_offsets[VALID]:])
+
+    def _advance_global_offset(self):
+        """Next (end_offset, size) pair; wraps into a new epoch."""
+        if self._global_offset >= self.effective_total:
+            self._global_offset = 0
+            self.epoch_number += 1
+            self.shuffle()
+        cls = self.class_of_offset(self._global_offset + 1)
+        class_end = self.class_end_offsets[cls]
+        if cls == TRAIN:
+            class_end = (self.class_end_offsets[VALID] +
+                         self.effective_train_length)
+        size = min(self.max_minibatch_size,
+                   class_end - self._global_offset)
+        self._global_offset += size
+        return self._global_offset, size
+
+    def serve_next_minibatch(self, slave_id=None):
+        try:
+            minibatch_def = self.failed_minibatches.pop()
+        except IndexError:
+            minibatch_def = self._advance_global_offset()
+        self.pending_minibatches_[slave_id].append(minibatch_def)
+        self.minibatch_offset, self.minibatch_size = minibatch_def
+        self.minibatch_class = self.class_of_offset(self.minibatch_offset)
+        if self.fill_indices(self.minibatch_offset - self.minibatch_size,
+                             self.minibatch_size):
+            return
+        self.fill_minibatch()
+        self.normalize_minibatch()
+        self.map_minibatch_labels()
+        if self.minibatch_size < self.max_minibatch_size:
+            self.minibatch_data.map_write()[self.minibatch_size:] = 0
+            if self.has_labels:
+                self.minibatch_labels.map_write()[self.minibatch_size:] = -1
+            self.minibatch_indices.map_write()[self.minibatch_size:] = -1
+
+    def _on_successful_serve(self):
+        self.samples_served += self.minibatch_size
+        cls = self.minibatch_class
+        class_end = self.class_end_offsets[cls]
+        if cls == TRAIN:
+            class_end = (self.class_end_offsets[VALID] +
+                         self.effective_train_length)
+        self.last_minibatch <<= (self.minibatch_offset >= class_end)
+        self.train_ended <<= bool(self.last_minibatch) and cls == TRAIN
+        # epoch ends once the last class with samples completes
+        last_cls = TRAIN if self.class_lengths[TRAIN] else (
+            VALID if self.class_lengths[VALID] else TEST)
+        self.epoch_ended <<= bool(self.last_minibatch) and cls == last_cls
+
+    @property
+    def class_ended(self):
+        return bool(self.last_minibatch)
+
+    # -- normalization analysis (reference base.py:755-800) ------------------
+    def analyze_dataset(self):
+        if self.class_lengths[TRAIN] == 0:
+            return
+        if isinstance(self.normalizer, normalization.StatelessNormalizer):
+            self.normalizer.analyze(self.minibatch_data.mem)
+            return
+        saved = (self._global_offset, self.minibatch_offset,
+                 self.minibatch_size, self.minibatch_class)
+        self.shuffled_indices.mem = numpy.arange(
+            self.total_samples, dtype=self.INDEX_DTYPE)
+        offset = self.class_end_offsets[VALID]
+        end = self.class_end_offsets[TRAIN]
+        while offset < end:
+            size = min(self.max_minibatch_size, end - offset)
+            self.minibatch_offset, self.minibatch_size = offset + size, size
+            self.minibatch_indices.map_write()[:size] = \
+                self.shuffled_indices[offset:offset + size]
+            self.fill_minibatch()
+            self.normalizer.analyze(
+                self.minibatch_data.map_read()[:size])
+            offset += size
+        (self._global_offset, self.minibatch_offset,
+         self.minibatch_size, self.minibatch_class) = saved
+
+    def normalize_minibatch(self):
+        self.normalizer.normalize(
+            self.minibatch_data.map_write()[:self.minibatch_size])
+
+    def map_minibatch_labels(self):
+        if not self.has_labels:
+            return
+        mem = self.minibatch_labels.map_write()
+        for i, raw in enumerate(
+                self.raw_minibatch_labels[:self.minibatch_size]):
+            if raw is None:
+                continue
+            mem[i] = self.labels_mapping.setdefault(
+                raw, len(self.labels_mapping))
+
+    # -- IDistributable (master serves indices only, base.py:631-663) --------
+    def generate_data_for_master(self):
+        return True
+
+    def generate_data_for_slave(self, slave=None):
+        self.serve_next_minibatch(getattr(slave, "id", slave))
+        data = {"indices":
+                numpy.array(self.minibatch_indices[:self.minibatch_size])}
+        for attr in ("minibatch_class", "minibatch_size", "minibatch_offset",
+                     "epoch_number"):
+            data[attr] = getattr(self, attr)
+        return data
+
+    def apply_data_from_master(self, data):
+        for attr in ("minibatch_class", "minibatch_size", "minibatch_offset",
+                     "epoch_number"):
+            setattr(self, attr, data[attr])
+        self.last_minibatch <<= False
+        self.epoch_ended <<= False
+        self.train_ended <<= False
+        indices = data["indices"]
+        if indices.size != self.minibatch_size:
+            raise LoaderError("minibatch size mismatch")
+        if not self.shuffled_indices:
+            self.shuffled_indices.mem = numpy.arange(
+                self.total_samples, dtype=self.INDEX_DTYPE)
+        self.shuffled_indices.map_write()[
+            self.minibatch_offset - self.minibatch_size:
+            self.minibatch_offset] = indices
+        self.serve_from_applied_indices()
+
+    def serve_from_applied_indices(self):
+        """Slave-side gather for the indices the master assigned."""
+        if self.fill_indices(self.minibatch_offset - self.minibatch_size,
+                             self.minibatch_size):
+            return
+        self.fill_minibatch()
+        self.normalize_minibatch()
+        self.map_minibatch_labels()
+
+    def apply_data_from_slave(self, data, slave=None):
+        sid = getattr(slave, "id", slave)
+        try:
+            self.minibatch_offset, self.minibatch_size = \
+                self.pending_minibatches_[sid].pop()
+        except (KeyError, IndexError):
+            raise LoaderError("no pending minibatch for slave %s" % sid)
+        self._on_successful_serve()
+
+    def drop_slave(self, slave=None):
+        sid = getattr(slave, "id", slave)
+        if sid in self.pending_minibatches_:
+            self.failed_minibatches.extend(self.pending_minibatches_[sid])
+            del self.pending_minibatches_[sid]
+
+    @property
+    def has_data_for_slave(self):
+        return (not self.class_ended) or len(self.failed_minibatches) > 0
+
+    # -- IResultProvider -----------------------------------------------------
+    def get_metric_values(self):
+        return {"Total epochs": self.epoch_number}

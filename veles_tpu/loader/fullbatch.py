@@ -1,0 +1,186 @@
+"""FullBatchLoader: the whole dataset resident in device HBM.
+
+TPU-native re-design of /root/reference/veles/loader/fullbatch.py (:79; GPU
+residency with OOM fallback :158-196; on-device minibatch gather kernel
+``ocl/fullbatch_loader.cl`` / ``cuda/fullbatch_loader.cu``).  The reference
+gathers minibatches on-device with a hand-written kernel walking
+``shuffled_indices``; on TPU the same operation is one ``jnp.take`` inside a
+jitted gather — XLA lowers it to an efficient dynamic-gather and fuses the
+dtype cast.  Normalization is applied to the resident dataset once at
+initialize (train-statistics analyze pass first), so the per-step path is
+pure gather.
+"""
+
+import numpy
+
+from ..memory import Array
+from .. import normalization
+from .base import Loader, TRAIN, VALID
+
+
+class FullBatchLoader(Loader):
+    """Dataset-as-one-Array loader with device-side gather.
+
+    Subclasses implement ``load_data()`` filling ``original_data`` (and
+    ``original_labels`` when ``has_labels``) plus ``class_lengths``.
+    """
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.original_data = Array(shallow_pickle=True)
+        self.original_labels = []
+        self.force_numpy = bool(kwargs.get("force_numpy", False))
+        self._dtype = kwargs.get("dtype", numpy.float32)
+
+    def create_minibatch_data(self):
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + self.original_data.shape[1:],
+            self._dtype))
+
+    def fill_minibatch(self):
+        """Host twin of the device gather (numpy path + analysis pass)."""
+        idx = self.minibatch_indices.map_read()[:self.minibatch_size]
+        self.minibatch_data.map_write()[:self.minibatch_size] = \
+            self.original_data[idx]
+        if self.has_labels:
+            for i, sample_idx in enumerate(idx):
+                self.raw_minibatch_labels[i] = \
+                    self.original_labels[sample_idx]
+
+    # -- device path ---------------------------------------------------------
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        self.device = device
+        self._use_device = (device is not None and device.exists and
+                            not self.force_numpy)
+        if self._use_device:
+            self._device_init()
+
+    def analyze_dataset(self):
+        """Analyze train statistics, then bake normalization into the
+        resident dataset so the hot path is gather-only."""
+        if self.class_lengths[TRAIN] and not isinstance(
+                self.normalizer, normalization.StatelessNormalizer):
+            train = self.original_data.map_read()[
+                self.class_end_offsets[VALID]:self.class_end_offsets[TRAIN]]
+            self.normalizer.analyze(train.astype(numpy.float64))
+        else:
+            self.normalizer.analyze(self.original_data.mem)
+        data = self.original_data.map_write().astype(self._dtype)
+        if not isinstance(self.normalizer, normalization.NoneNormalizer):
+            self.normalizer.normalize(data)
+        self.original_data.mem = data
+        # labels → dense int mapping once, host-side
+        if self.has_labels:
+            self._dense_labels = numpy.zeros(len(self.original_labels),
+                                             self.LABEL_DTYPE)
+            for i, raw in enumerate(self.original_labels):
+                self._dense_labels[i] = self.labels_mapping.setdefault(
+                    raw, len(self.labels_mapping))
+
+    def _device_init(self):
+        import jax
+        import jax.numpy as jnp
+        data_dev = self.original_data.devmem  # one upload, stays resident
+
+        if self.has_labels:
+            labels_dev = jax.device_put(self._dense_labels)
+
+            @jax.jit
+            def gather(idx):
+                return (jnp.take(data_dev, idx, axis=0),
+                        jnp.take(labels_dev, idx, axis=0))
+        else:
+            @jax.jit
+            def gather(idx):
+                return jnp.take(data_dev, idx, axis=0)
+        self._gather_ = gather
+
+    def fill_indices(self, start_offset, count):
+        super().fill_indices(start_offset, count)
+        if not getattr(self, "_use_device", False):
+            return False
+        idx = numpy.zeros(self.max_minibatch_size, self.INDEX_DTYPE)
+        idx[:count] = self.shuffled_indices[start_offset:start_offset + count]
+        if count < self.max_minibatch_size:
+            idx[count:] = idx[0]  # pad with a valid index; masked downstream
+        out = self._gather_(idx)
+        if self.has_labels:
+            self.minibatch_data.devmem, self.minibatch_labels.devmem = out
+        else:
+            self.minibatch_data.devmem = out
+        return True
+
+    def normalize_minibatch(self):
+        pass  # already baked into the resident dataset
+
+    def map_minibatch_labels(self):
+        if not self.has_labels:
+            return
+        idx = self.minibatch_indices.map_read()[:self.minibatch_size]
+        self.minibatch_labels.map_write()[:self.minibatch_size] = \
+            self._dense_labels[idx]
+
+
+class FullBatchLoaderMSE(FullBatchLoader):
+    """FullBatch variant with regression targets (reference
+    fullbatch.py:467-563)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.original_targets = Array(shallow_pickle=True)
+        self.minibatch_targets = Array()
+        self.has_labels = False
+        self.targets_normalizer = normalization.factory(
+            kwargs.get("target_normalization_type", "none"),
+            **kwargs.get("target_normalization_parameters", {}))
+
+    def create_minibatch_data(self):
+        super().create_minibatch_data()
+        self.minibatch_targets.reset(numpy.zeros(
+            (self.max_minibatch_size,) + self.original_targets.shape[1:],
+            self._dtype))
+
+    def analyze_dataset(self):
+        super().analyze_dataset()
+        targets = self.original_targets.map_write().astype(self._dtype)
+        self.targets_normalizer.analyze(targets)
+        if not isinstance(self.targets_normalizer,
+                          normalization.NoneNormalizer):
+            self.targets_normalizer.normalize(targets)
+        self.original_targets.mem = targets
+
+    def _device_init(self):
+        import jax
+        import jax.numpy as jnp
+        data_dev = self.original_data.devmem
+        targets_dev = self.original_targets.devmem
+
+        @jax.jit
+        def gather(idx):
+            return (jnp.take(data_dev, idx, axis=0),
+                    jnp.take(targets_dev, idx, axis=0))
+        self._gather_ = gather
+
+    def fill_indices(self, start_offset, count):
+        Loader.fill_indices(self, start_offset, count)
+        if not getattr(self, "_use_device", False):
+            return False
+        idx = numpy.zeros(self.max_minibatch_size, self.INDEX_DTYPE)
+        idx[:count] = self.shuffled_indices[start_offset:start_offset + count]
+        if count < self.max_minibatch_size:
+            idx[count:] = idx[0]
+        self.minibatch_data.devmem, self.minibatch_targets.devmem = \
+            self._gather_(idx)
+        return True
+
+    def fill_minibatch(self):
+        idx = self.minibatch_indices.map_read()[:self.minibatch_size]
+        self.minibatch_data.map_write()[:self.minibatch_size] = \
+            self.original_data[idx]
+        self.minibatch_targets.map_write()[:self.minibatch_size] = \
+            self.original_targets[idx]

@@ -215,6 +215,12 @@ class Array(Pickleable):
             self._accounted_ = 0
             self._devmem_ = None
 
+    def __del__(self):
+        try:
+            self._release_devmem()
+        except Exception:
+            pass  # interpreter teardown
+
     # -- pickling ------------------------------------------------------------
     def __getstate__(self):
         """Device values are pulled to host before pickling (reference

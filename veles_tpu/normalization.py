@@ -1,0 +1,388 @@
+"""Feature normalizers with a streaming analyze pass.
+
+TPU-native re-design of /root/reference/veles/normalization.py (registry at
+:110-124, the eight MAPPING'd families :260-660).  Same behavioral contract:
+``analyze(batch)`` accumulates statistics over a streaming pass (the loader
+calls it per-minibatch during its normalization analysis,
+loader/base.py:760-800), ``normalize(data)`` mutates in place,
+``denormalize`` inverts, and normalizer state pickles into snapshots.
+
+The math is plain numpy on purpose: analysis happens once, host-side, at
+dataset load; the *per-step* application is fused into the jitted input
+pipeline via :meth:`NormalizerBase.jax_apply` which returns the same
+transform as a pure jnp expression (the TPU replacement for the reference's
+``mean_disp_normalizer`` device kernel, ocl/mean_disp_normalizer.cl).
+"""
+
+import numpy
+
+from .registry import MappedObjectsRegistry
+
+
+class UninitializedStateError(Exception):
+    pass
+
+
+class NormalizerBase(metaclass=MappedObjectsRegistry):
+    """Base: streaming analyze + in-place normalize/denormalize."""
+
+    mapping = "normalizer"
+
+    def __init__(self, state=None, **kwargs):
+        self._initialized = False
+        if state is not None:
+            self.state = state
+
+    # -- streaming analysis --------------------------------------------------
+    def analyze(self, data):
+        data = numpy.asarray(data)
+        if not self._initialized:
+            self._initialize(data)
+            self._initialized = True
+        self._analyze(data)
+
+    def analyze_and_normalize(self, data):
+        self.analyze(data)
+        self.normalize(data)
+        return data
+
+    def _initialize(self, data):
+        pass
+
+    def _analyze(self, data):
+        pass
+
+    # -- application ---------------------------------------------------------
+    def normalize(self, data):
+        raise NotImplementedError
+
+    def denormalize(self, data):
+        raise NotImplementedError
+
+    def jax_apply(self, x):
+        """The same transform as a pure jnp expression for fusion into the
+        jitted input pipeline.  Default: run numpy path via callback-free
+        broadcastable coefficients; stateless subclasses override."""
+        raise NotImplementedError(
+            "%s cannot be fused; apply host-side" % type(self).__name__)
+
+    # -- snapshot state ------------------------------------------------------
+    @property
+    def state(self):
+        if not self._initialized and self._has_state():
+            raise UninitializedStateError(
+                "uninitialized normalizers have no state")
+        return {k: v for k, v in self.__dict__.items()
+                if k != "_initialized"}
+
+    @state.setter
+    def state(self, value):
+        if not isinstance(value, dict):
+            raise TypeError("state must be a dict")
+        self.__dict__.update(value)
+        self._initialized = True
+
+    def _has_state(self):
+        return True
+
+    def __getstate__(self):
+        return dict(self.__dict__)
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+class StatelessNormalizer(NormalizerBase):
+    """analyze() is a no-op (reference normalization.py:260-282)."""
+
+    def analyze(self, data):
+        self._initialized = True
+
+    def _has_state(self):
+        return False
+
+
+class NoneNormalizer(StatelessNormalizer):
+    MAPPING = "none"
+
+    def normalize(self, data):
+        return data
+
+    def denormalize(self, data):
+        return data
+
+    def jax_apply(self, x):
+        return x
+
+
+class MeanDispersionNormalizer(NormalizerBase):
+    """(x - mean) / (max - min), computed featurewise over the analyze pass.
+
+    Note: like the reference (normalization.py:284-319), "dispersion" is the
+    max-min spread, not the statistical variance.
+    """
+
+    MAPPING = "mean_disp"
+
+    def _initialize(self, data):
+        self._sum = numpy.zeros_like(data[0], dtype=numpy.float64)
+        self._count = 0
+        self._min = numpy.array(data[0], dtype=numpy.float64)
+        self._max = numpy.array(data[0], dtype=numpy.float64)
+
+    def _analyze(self, data):
+        self._count += data.shape[0]
+        self._sum += numpy.sum(data, axis=0, dtype=numpy.float64)
+        numpy.minimum(self._min, data.min(axis=0), self._min)
+        numpy.maximum(self._max, data.max(axis=0), self._max)
+
+    @property
+    def coefficients(self):
+        mean = self._sum / self._count
+        disp = self._max - self._min
+        disp = numpy.where(disp == 0, 1.0, disp)
+        return mean, disp
+
+    def normalize(self, data):
+        mean, disp = self.coefficients
+        data -= mean
+        data /= disp
+        return data
+
+    def denormalize(self, data):
+        mean, disp = self.coefficients
+        data *= disp
+        data += mean
+        return data
+
+    def jax_apply(self, x):
+        import jax.numpy as jnp
+        mean, disp = self.coefficients
+        return (x - jnp.asarray(mean, x.dtype)) * jnp.asarray(
+            1.0 / disp, x.dtype)
+
+
+class LinearNormalizer(StatelessNormalizer):
+    """Samplewise linear map of each sample's [min, max] onto ``interval``
+    (reference normalization.py:347-396)."""
+
+    MAPPING = "linear"
+
+    def __init__(self, state=None, interval=(-1, 1), **kwargs):
+        super().__init__(state, **kwargs)
+        if state is None:
+            self.interval = (float(interval[0]), float(interval[1]))
+
+    def normalize(self, data):
+        flat = data.reshape(len(data), -1)
+        dmin = flat.min(axis=1, keepdims=True)
+        dmax = flat.max(axis=1, keepdims=True)
+        imin, imax = self.interval
+        diff = dmax - dmin
+        uniform = (diff == 0)
+        diff = numpy.where(uniform, 1.0, diff)
+        # out = (x - dmin) * (imax - imin) / diff + imin;
+        # uniform samples land on the interval midpoint (reference
+        # normalization.py:363-374)
+        flat -= dmin
+        flat *= (imax - imin) / diff
+        flat += imin
+        if uniform.any():
+            flat[uniform[:, 0]] = (imin + imax) / 2
+        return data
+
+    def jax_apply(self, x):
+        import jax.numpy as jnp
+        flat = x.reshape(x.shape[0], -1)
+        dmin = flat.min(axis=1, keepdims=True)
+        dmax = flat.max(axis=1, keepdims=True)
+        imin, imax = self.interval
+        diff = dmax - dmin
+        safe = jnp.where(diff == 0, 1.0, diff)
+        out = (flat - dmin) * ((imax - imin) / safe) + imin
+        out = jnp.where(diff == 0, (imin + imax) / 2, out)
+        return out.reshape(x.shape)
+
+
+class RangeLinearNormalizer(NormalizerBase):
+    """Linear map of the *global* [min, max] (from analyze) onto ``interval``
+    (reference normalization.py:398-464)."""
+
+    MAPPING = "range_linear"
+
+    def __init__(self, state=None, interval=(-1, 1), **kwargs):
+        super().__init__(state, **kwargs)
+        if state is None:
+            self.interval = (float(interval[0]), float(interval[1]))
+
+    def _initialize(self, data):
+        self._min = float(numpy.min(data))
+        self._max = float(numpy.max(data))
+
+    def _analyze(self, data):
+        self._min = min(self._min, float(numpy.min(data)))
+        self._max = max(self._max, float(numpy.max(data)))
+
+    def normalize(self, data):
+        imin, imax = self.interval
+        diff = self._max - self._min or 1.0
+        data -= self._min
+        data *= (imax - imin) / diff
+        data += imin
+        return data
+
+    def denormalize(self, data):
+        imin, imax = self.interval
+        diff = self._max - self._min or 1.0
+        data -= imin
+        data *= diff / (imax - imin)
+        data += self._min
+        return data
+
+    def jax_apply(self, x):
+        imin, imax = self.interval
+        diff = self._max - self._min or 1.0
+        return (x - self._min) * ((imax - imin) / diff) + imin
+
+
+class ExponentNormalizer(StatelessNormalizer):
+    """Samplewise softmax: exp(x - max) / sum (reference
+    normalization.py:467-494)."""
+
+    MAPPING = "exp"
+
+    def normalize(self, data):
+        flat = data.reshape(len(data), -1)
+        flat -= flat.max(axis=1, keepdims=True)
+        numpy.exp(flat, flat)
+        flat /= flat.sum(axis=1, keepdims=True)
+        return data
+
+    def denormalize(self, data):
+        flat = data.reshape(len(data), -1)
+        numpy.log(flat, flat)
+        return data
+
+    def jax_apply(self, x):
+        import jax
+        return jax.nn.softmax(x.reshape(x.shape[0], -1)).reshape(x.shape)
+
+
+class PointwiseNormalizer(NormalizerBase):
+    """Featurewise map of the analyzed per-feature [min, max] onto [-1, 1]
+    (reference normalization.py:511-563)."""
+
+    MAPPING = "pointwise"
+
+    def _initialize(self, data):
+        self._min = numpy.array(data[0], dtype=numpy.float64)
+        self._max = numpy.array(data[0], dtype=numpy.float64)
+
+    def _analyze(self, data):
+        numpy.minimum(self._min, data.min(axis=0), self._min)
+        numpy.maximum(self._max, data.max(axis=0), self._max)
+
+    @property
+    def coefficients(self):
+        diff = self._max - self._min
+        disp = numpy.where(diff == 0, 1.0, diff)
+        mul = 2.0 / disp
+        add = -1.0 - self._min * mul
+        return mul, add
+
+    def normalize(self, data):
+        mul, add = self.coefficients
+        data *= mul
+        data += add
+        return data
+
+    def denormalize(self, data):
+        mul, add = self.coefficients
+        data -= add
+        data /= mul
+        return data
+
+    def jax_apply(self, x):
+        import jax.numpy as jnp
+        mul, add = self.coefficients
+        return x * jnp.asarray(mul, x.dtype) + jnp.asarray(add, x.dtype)
+
+
+class ExternalMeanNormalizer(StatelessNormalizer):
+    """Subtract a supplied mean array (e.g. an ImageNet mean image;
+    reference normalization.py:593-633)."""
+
+    MAPPING = "external_mean"
+
+    def __init__(self, state=None, mean_source=None, scale=1.0, **kwargs):
+        super().__init__(state, **kwargs)
+        if state is None:
+            if mean_source is None:
+                raise ValueError("external_mean requires mean_source")
+            if isinstance(mean_source, str):
+                mean_source = numpy.load(mean_source)
+            self.mean = numpy.asarray(mean_source)
+            self.scale = float(scale)
+
+    def normalize(self, data):
+        data -= self.mean
+        if self.scale != 1.0:
+            data *= self.scale
+        return data
+
+    def denormalize(self, data):
+        if self.scale != 1.0:
+            data /= self.scale
+        data += self.mean
+        return data
+
+    def jax_apply(self, x):
+        import jax.numpy as jnp
+        return (x - jnp.asarray(self.mean, x.dtype)) * x.dtype.type(
+            self.scale)
+
+
+class InternalMeanNormalizer(NormalizerBase):
+    """Subtract the mean computed over the analyze pass (reference
+    normalization.py:636-660)."""
+
+    MAPPING = "internal_mean"
+
+    def __init__(self, state=None, scale=1.0, **kwargs):
+        super().__init__(state, **kwargs)
+        if state is None:
+            self.scale = float(scale)
+
+    def _initialize(self, data):
+        self._sum = numpy.zeros_like(data[0], dtype=numpy.float64)
+        self._count = 0
+
+    def _analyze(self, data):
+        self._sum += numpy.sum(data, axis=0, dtype=numpy.float64)
+        self._count += data.shape[0]
+
+    @property
+    def mean(self):
+        return self._sum / self._count
+
+    def normalize(self, data):
+        data -= self.mean
+        if self.scale != 1.0:
+            data *= self.scale
+        return data
+
+    def denormalize(self, data):
+        if self.scale != 1.0:
+            data /= self.scale
+        data += self.mean
+        return data
+
+    def jax_apply(self, x):
+        import jax.numpy as jnp
+        return (x - jnp.asarray(self.mean, x.dtype)) * x.dtype.type(
+            self.scale)
+
+
+def factory(name, **kwargs):
+    """Instantiate a normalizer by MAPPING key."""
+    return MappedObjectsRegistry.get("normalizer", name)(**kwargs)

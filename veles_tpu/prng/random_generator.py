@@ -27,9 +27,14 @@ class RandomGenerator:
         """Seed from an int, bytes, or an array (the reference accepts raw
         seed files and hex strings, __main__.py:483-539)."""
         if isinstance(seed, (bytes, bytearray)):
-            seed = numpy.frombuffer(seed, dtype=numpy.uint32)
+            pad = (-len(seed)) % 4
+            seed = numpy.frombuffer(bytes(seed) + b"\0" * pad,
+                                    dtype=numpy.uint32)
         if isinstance(seed, numpy.ndarray):
-            seed = int(numpy.bitwise_xor.reduce(seed.view(numpy.uint32)))
+            raw = seed.tobytes()
+            raw += b"\0" * ((-len(raw)) % 4)
+            seed = int(numpy.bitwise_xor.reduce(
+                numpy.frombuffer(raw, numpy.uint32)))
         self._seed_value = int(seed) & 0xFFFFFFFF
         self._state = numpy.random.RandomState(self._seed_value)
         return self
