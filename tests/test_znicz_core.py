@@ -1,0 +1,205 @@
+"""Core NN unit tests: forward device/numpy parity, explicit backward vs
+jax.grad autodiff equivalence, solver behavior, and a full graph-mode
+training loop that must converge on a separable synthetic problem (the
+reference pattern: every unit tested against its numpy twin, SURVEY.md §4.1).
+"""
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.memory import Array
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.workflow import Workflow
+from veles_tpu.znicz import (
+    All2All, All2AllTanh, All2AllSigmoid, All2AllRELU, All2AllStrictRELU,
+    All2AllSoftmax, GradientDescent, GDTanh, GDSoftmax, EvaluatorSoftmax,
+)
+from veles_tpu.znicz import solvers
+
+
+FORWARD_CLASSES = [All2All, All2AllTanh, All2AllSigmoid, All2AllRELU,
+                   All2AllStrictRELU, All2AllSoftmax]
+
+
+def make_forward(cls, backend="cpu", n_in=12, n_out=5, seed=11):
+    wf = Workflow(name="w")
+    fwd = cls(wf, output_sample_shape=n_out,
+              prng=RandomGenerator().seed(seed))
+    rng = numpy.random.RandomState(0)
+    fwd.input = Array(rng.uniform(-1, 1, (8, n_in)).astype(numpy.float32))
+    fwd.initialize(device=Device(backend=backend))
+    return fwd
+
+
+@pytest.mark.parametrize("cls", FORWARD_CLASSES)
+def test_forward_device_numpy_parity(cls):
+    dev = make_forward(cls, "cpu")
+    ref = make_forward(cls, "numpy")
+    dev.run()
+    ref.run()
+    assert numpy.allclose(dev.output.map_read(), ref.output.map_read(),
+                          atol=1e-5)
+
+
+def test_softmax_properties():
+    fwd = make_forward(All2AllSoftmax, "cpu")
+    fwd.run()
+    out = fwd.output.map_read()
+    assert numpy.allclose(out.sum(axis=1), 1.0, atol=1e-5)
+    assert numpy.array_equal(fwd.max_idx.map_read(),
+                             out.argmax(axis=1))
+
+
+@pytest.mark.parametrize("fwd_cls,gd_cls", [(All2All, GradientDescent),
+                                            (All2AllTanh, GDTanh)])
+def test_backward_matches_autodiff(fwd_cls, gd_cls):
+    """Explicit backward math must equal jax.grad of the forward."""
+    import jax
+    import jax.numpy as jnp
+    fwd = make_forward(fwd_cls, "cpu")
+    fwd.run()
+    wf = fwd.workflow
+    gd = gd_cls(wf, learning_rate=0.0)  # lr 0: no update, just gradients
+    gd.link_forward(fwd)
+    rng = numpy.random.RandomState(1)
+    err_out = rng.uniform(-1, 1, fwd.output.shape).astype(numpy.float32)
+    gd.err_output = Array(err_out)
+    gd.initialize(device=Device(backend="cpu"))
+
+    params = {k: jnp.asarray(v) for k, v in fwd.params.items()}
+    x = jnp.asarray(fwd.input.map_read())
+
+    def scalar_loss(params, x):
+        y = fwd.apply(params, x)
+        return (y * jnp.asarray(err_out)).sum() / x.shape[0]
+
+    auto_grads = jax.grad(scalar_loss)(params, x)
+    _, grads = gd.backward(params, x, jnp.asarray(fwd.output.map_read()),
+                           jnp.asarray(err_out))
+    for k in grads:
+        assert numpy.allclose(numpy.asarray(grads[k]),
+                              numpy.asarray(auto_grads[k]), atol=1e-4), k
+
+    # err_input must equal the gradient wrt x
+    auto_err_in = jax.grad(lambda xx: scalar_loss(params, xx) *
+                           x.shape[0])(x)
+    err_in, _ = gd.backward(params, x, jnp.asarray(fwd.output.map_read()),
+                            jnp.asarray(err_out))
+    assert numpy.allclose(numpy.asarray(err_in),
+                          numpy.asarray(auto_err_in), atol=1e-4)
+
+
+def test_gd_device_numpy_parity():
+    results = {}
+    for backend in ("cpu", "numpy"):
+        fwd = make_forward(All2AllTanh, backend)
+        fwd.run()
+        gd = GDTanh(fwd.workflow, learning_rate=0.1, gradient_moment=0.9)
+        gd.link_forward(fwd)
+        rng = numpy.random.RandomState(2)
+        gd.err_output = Array(
+            rng.uniform(-1, 1, fwd.output.shape).astype(numpy.float32))
+        gd.initialize(device=Device(backend=backend))
+        gd.run()
+        gd.run()  # second step exercises momentum state
+        results[backend] = (numpy.array(fwd.weights.map_read()),
+                            numpy.array(fwd.bias.map_read()),
+                            numpy.array(gd.err_input.map_read()))
+    for a, b in zip(results["cpu"], results["numpy"]):
+        assert numpy.allclose(a, b, atol=1e-4)
+
+
+@pytest.mark.parametrize("solver_name", ["sgd", "momentum", "adagrad",
+                                         "adadelta", "rprop"])
+def test_solvers_reduce_quadratic(solver_name):
+    """Every solver must make progress on a simple quadratic."""
+    s = solvers.factory(solver_name)
+    lr = {"adagrad": 1.0, "adadelta": 20.0}.get(solver_name, 0.05)
+    w = numpy.array([5.0, -3.0])
+    state = s.init(w)
+    for _ in range(200):
+        grad = 2 * w
+        delta, state = s.update(grad, w, state, lr)
+        w = w + delta
+    assert numpy.abs(w).max() < 0.5, (solver_name, w)
+
+
+def test_regularization_gradients():
+    w = numpy.array([[1.0, -2.0], [0.5, 0.0]])
+    g0 = numpy.zeros_like(w)
+    l2 = solvers.regularized_grad(g0, w, 0.1, 0.0)
+    assert numpy.allclose(l2, 0.1 * w)
+    l1 = solvers.regularized_grad(g0, w, 0.1, 1.0)
+    assert numpy.allclose(l1, 0.05 * numpy.sign(w))
+
+
+def test_graph_mode_training_converges():
+    """2-layer net on separable gaussian blobs, full unit-graph loop."""
+    from veles_tpu import Repeater
+    from veles_tpu.loader import FullBatchLoader, TEST, VALID, TRAIN
+    from veles_tpu.znicz import DecisionGD
+
+    class BlobLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.RandomState(4)
+            n_per, n_classes, dim = 40, 3, 6
+            centers = rng.uniform(-2, 2, (n_classes, dim))
+            data, labels = [], []
+            for c in range(n_classes):
+                data.append(centers[c] +
+                            0.3 * rng.standard_normal((n_per, dim)))
+                labels += [c] * n_per
+            data = numpy.concatenate(data).astype(numpy.float32)
+            order = rng.permutation(len(data))
+            self.original_data.mem = data[order]
+            self.original_labels = list(numpy.array(labels)[order])
+            self.class_lengths[TEST] = 0
+            self.class_lengths[VALID] = 30
+            self.class_lengths[TRAIN] = 90
+
+    wf = Workflow(name="train")
+    repeater = Repeater(wf)
+    loader = BlobLoader(wf, minibatch_size=30,
+                        prng=RandomGenerator().seed(10))
+    hidden = All2AllTanh(wf, output_sample_shape=16,
+                         prng=RandomGenerator().seed(20))
+    out = All2AllSoftmax(wf, output_sample_shape=3,
+                         prng=RandomGenerator().seed(21))
+    ev = EvaluatorSoftmax(wf)
+    decision = DecisionGD(wf, max_epochs=15, silent=True)
+    gd_out = GDSoftmax(wf, learning_rate=0.5)
+    gd_hidden = GDTanh(wf, learning_rate=0.5)
+
+    repeater.link_from(wf.start_point)
+    loader.link_from(repeater)
+    hidden.link_from(loader)
+    hidden.link_attrs(loader, ("input", "minibatch_data"))
+    out.link_from(hidden)
+    out.link_attrs(hidden, ("input", "output"))
+    ev.link_from(out)
+    ev.link_attrs(out, "output", "max_idx")
+    ev.link_attrs(loader, ("labels", "minibatch_labels"),
+                  ("batch_size", "minibatch_size"))
+    decision.link_from(ev)
+    decision.link_loader(loader)
+    decision.link_evaluator(ev)
+    gd_out.link_from(decision)
+    gd_out.link_forward(out)
+    gd_out.link_attrs(ev, "err_output")
+    gd_hidden.link_from(gd_out)
+    gd_hidden.link_forward(hidden)
+    gd_hidden.link_attrs(gd_out, ("err_output", "err_input"))
+    # train only on train minibatches: skip GD outside TRAIN class
+    for gd in (gd_out, gd_hidden):
+        gd.gate_skip = wf.make_train_gate(loader)
+    repeater.link_from(gd_hidden)
+    wf.end_point.link_from(gd_hidden)
+    wf.end_point.gate_block = ~decision.complete
+    repeater.gate_block = decision.complete
+
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    assert wf.is_finished
+    assert decision.best_n_err_pt is not None
+    assert decision.best_n_err_pt < 10.0, decision.best_n_err_pt

@@ -163,8 +163,10 @@ class Loader(Unit, IResultProvider):
 
     def run(self):
         """Serve one minibatch (standalone mode)."""
-        self.pending_minibatches_.pop(None, None)
         self.serve_next_minibatch(None)
+        # standalone: the minibatch is consumed synchronously, so it is no
+        # longer outstanding when the epoch flags update
+        self.pending_minibatches_.pop(None, None)
         self._on_successful_serve()
 
     # -- serving -------------------------------------------------------------
@@ -222,7 +224,14 @@ class Loader(Unit, IResultProvider):
         if cls == TRAIN:
             class_end = (self.class_end_offsets[VALID] +
                          self.effective_train_length)
-        self.last_minibatch <<= (self.minibatch_offset >= class_end)
+        # the class only ends when nothing is still pending or requeued
+        # (reference base.py:863-871) — otherwise a dropped slave's
+        # minibatch would leak into the next epoch's accounting
+        outstanding = (len(self.failed_minibatches) +
+                       sum(len(v) for v in
+                           self.pending_minibatches_.values()))
+        self.last_minibatch <<= (self.minibatch_offset >= class_end and
+                                 outstanding == 0)
         self.train_ended <<= bool(self.last_minibatch) and cls == TRAIN
         # epoch ends once the last class with samples completes
         last_cls = TRAIN if self.class_lengths[TRAIN] else (
@@ -236,6 +245,11 @@ class Loader(Unit, IResultProvider):
     # -- normalization analysis (reference base.py:755-800) ------------------
     def analyze_dataset(self):
         if self.class_lengths[TRAIN] == 0:
+            return
+        if getattr(self.workflow, "restored_from_snapshot", False) and \
+                not self.testing:
+            # normalizer state came back with the snapshot; re-analyzing
+            # would double-accumulate and clobber the restored shuffle
             return
         if isinstance(self.normalizer, normalization.StatelessNormalizer):
             self.normalizer.analyze(self.minibatch_data.mem)
@@ -320,6 +334,7 @@ class Loader(Unit, IResultProvider):
                 self.pending_minibatches_[sid].pop()
         except (KeyError, IndexError):
             raise LoaderError("no pending minibatch for slave %s" % sid)
+        self.minibatch_class = self.class_of_offset(self.minibatch_offset)
         self._on_successful_serve()
 
     def drop_slave(self, slave=None):

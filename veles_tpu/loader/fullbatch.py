@@ -81,21 +81,20 @@ class FullBatchLoader(Loader):
                     raw, len(self.labels_mapping))
 
     def _device_init(self):
+        """Build the jitted gather over the resident sources.  Sources and
+        their destination Arrays are declared once so both the plain and
+        MSE variants share one fill_indices."""
         import jax
         import jax.numpy as jnp
-        data_dev = self.original_data.devmem  # one upload, stays resident
-
+        sources = [self.original_data.devmem]  # one upload, stays resident
+        self._gather_targets_ = [self.minibatch_data]
         if self.has_labels:
-            labels_dev = jax.device_put(self._dense_labels)
+            sources.append(jax.device_put(self._dense_labels))
+            self._gather_targets_.append(self.minibatch_labels)
 
-            @jax.jit
-            def gather(idx):
-                return (jnp.take(data_dev, idx, axis=0),
-                        jnp.take(labels_dev, idx, axis=0))
-        else:
-            @jax.jit
-            def gather(idx):
-                return jnp.take(data_dev, idx, axis=0)
+        @jax.jit
+        def gather(idx):
+            return tuple(jnp.take(src, idx, axis=0) for src in sources)
         self._gather_ = gather
 
     def fill_indices(self, start_offset, count):
@@ -106,11 +105,9 @@ class FullBatchLoader(Loader):
         idx[:count] = self.shuffled_indices[start_offset:start_offset + count]
         if count < self.max_minibatch_size:
             idx[count:] = idx[0]  # pad with a valid index; masked downstream
-        out = self._gather_(idx)
-        if self.has_labels:
-            self.minibatch_data.devmem, self.minibatch_labels.devmem = out
-        else:
-            self.minibatch_data.devmem = out
+        for target, val in zip(self._gather_targets_, self._gather_(idx),
+                               strict=True):
+            target.devmem = val
         return True
 
     def normalize_minibatch(self):
@@ -157,26 +154,14 @@ class FullBatchLoaderMSE(FullBatchLoader):
     def _device_init(self):
         import jax
         import jax.numpy as jnp
-        data_dev = self.original_data.devmem
-        targets_dev = self.original_targets.devmem
+        sources = [self.original_data.devmem, self.original_targets.devmem]
+        self._gather_targets_ = [self.minibatch_data,
+                                 self.minibatch_targets]
 
         @jax.jit
         def gather(idx):
-            return (jnp.take(data_dev, idx, axis=0),
-                    jnp.take(targets_dev, idx, axis=0))
+            return tuple(jnp.take(src, idx, axis=0) for src in sources)
         self._gather_ = gather
-
-    def fill_indices(self, start_offset, count):
-        Loader.fill_indices(self, start_offset, count)
-        if not getattr(self, "_use_device", False):
-            return False
-        idx = numpy.zeros(self.max_minibatch_size, self.INDEX_DTYPE)
-        idx[:count] = self.shuffled_indices[start_offset:start_offset + count]
-        if count < self.max_minibatch_size:
-            idx[count:] = idx[0]
-        self.minibatch_data.devmem, self.minibatch_targets.devmem = \
-            self._gather_(idx)
-        return True
 
     def fill_minibatch(self):
         idx = self.minibatch_indices.map_read()[:self.minibatch_size]

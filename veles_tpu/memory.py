@@ -32,7 +32,8 @@ class Watcher:
     the platform's own ``memory_stats`` when available.
     """
 
-    _lock = threading.Lock()
+    _lock = threading.RLock()  # reentrant: Array.__del__ may fire mid-GC
+    #                            inside add/remove on the same thread
     bytes_in_use = 0
     peak_bytes = 0
 

@@ -84,6 +84,14 @@ class Bool:
     def __invert__(self):
         return self._derived(lambda: not bool(self), "~%s" % self)
 
+    @classmethod
+    def from_callable(cls, fn, name=None):
+        """A derived Bool evaluating ``fn()`` each test — for gates over
+        non-Bool state (e.g. ``loader.minibatch_class != TRAIN``)."""
+        b = cls(name=name)
+        b._expr = lambda: bool(fn())
+        return b
+
     # -- misc ----------------------------------------------------------------
     @property
     def is_derived(self):

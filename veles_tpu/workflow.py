@@ -192,6 +192,17 @@ class Workflow(Container):
             print("WARNING: %s signaled after the workflow finished "
                   "(check your links)" % unit, file=sys.stderr)
 
+    def make_train_gate(self, loader):
+        """A gate_skip Bool that is True while the loader serves non-train
+        minibatches — wire it to GD units so updates happen only on the
+        train class (the reference links gds through Decision the same
+        way)."""
+        from .loader.base import TRAIN
+        from .mutable import Bool
+        return Bool.from_callable(
+            lambda: loader.minibatch_class != TRAIN,
+            name="not_train")
+
     # -- IDistributable aggregation (reference workflow.py:478-574) ----------
     def generate_data_for_master(self):
         data = []

@@ -1,0 +1,22 @@
+"""znicz: the neural-network unit library.
+
+TPU-native re-creation of the (absent) veles.znicz submodule — the layer
+inventory reconstructed in SURVEY.md §2.9 from
+/root/reference/docs/source/manualrst_veles_workflow_parameters.rst:469-504
+and manualrst_veles_algorithms.rst.  Forward/backward unit pairs over
+JAX/XLA: every forward exposes a *pure* ``apply(params, x)`` used both by
+its own jitted graph-mode kernel and by the fused single-step trainer that
+StandardWorkflow builds (SURVEY.md §7: the hot loop collapses into one
+jitted, donated step function).
+"""
+
+from . import activations                            # noqa: F401
+from .nn_units import ForwardBase, GradientDescentBase  # noqa: F401
+from .all2all import (All2All, All2AllTanh, All2AllSigmoid, All2AllRELU,
+                      All2AllStrictRELU, All2AllSoftmax,
+                      ResizableAll2All)                  # noqa: F401
+from .gd import (GradientDescent, GDTanh, GDSigmoid, GDRELU,
+                 GDStrictRELU, GDSoftmax, RPropAll2All)  # noqa: F401
+from .evaluator import EvaluatorSoftmax, EvaluatorMSE    # noqa: F401
+from .decision import (DecisionGD, DecisionMSE,
+                       TrivialDecision)                  # noqa: F401

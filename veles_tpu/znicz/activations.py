@@ -1,0 +1,117 @@
+"""Activation functions shared by all2all/conv forwards and the activation
+units.
+
+Semantics follow the Znicz kernel conventions (reconstructed; the submodule
+is absent — SURVEY.md §2.9):
+
+- ``tanh``: LeCun-scaled ``1.7159 * tanh(0.6666 * x)``;
+- ``relu``: smooth ``log(1 + exp(x))`` (Znicz's "RELU" is softplus);
+- ``strict_relu``: ``max(0, x)``;
+- ``sigmoid``: logistic;
+- plus the activation-unit extras log/tanhlog/sincos/mul.
+
+Each entry is ``(forward, derivative_from_output_and_input)``; derivatives
+take ``(y, x)`` because several Znicz backward kernels use the *output*
+(cheaper on-device: no need to keep x for tanh/sigmoid).
+"""
+
+import numpy
+
+
+def _np_softplus(x):
+    return numpy.log1p(numpy.exp(-numpy.abs(x))) + numpy.maximum(x, 0)
+
+
+class Activation:
+    """One activation: jnp + numpy forward, derivative for backprop."""
+
+    def __init__(self, name, fwd_jnp, fwd_np, deriv_jnp, deriv_np):
+        self.name = name
+        self.fwd_jnp = fwd_jnp
+        self.fwd_np = fwd_np
+        self.deriv_jnp = deriv_jnp
+        self.deriv_np = deriv_np
+
+
+def _make_table():
+    import jax.numpy as jnp
+    import jax
+    A, B = 1.7159, 0.6666
+
+    return {
+        "linear": Activation(
+            "linear",
+            lambda x: x, lambda x: x,
+            lambda y, x: jnp.ones_like(y), lambda y, x: numpy.ones_like(y)),
+        "tanh": Activation(
+            "tanh",
+            lambda x: A * jnp.tanh(B * x),
+            lambda x: A * numpy.tanh(B * x),
+            # dy/dx = A*B*(1 - tanh^2) = B/A * (A^2 - y^2)
+            lambda y, x: (y * y) * (-B / A) + A * B,
+            lambda y, x: (y * y) * (-B / A) + A * B),
+        "sigmoid": Activation(
+            "sigmoid",
+            lambda x: jax.nn.sigmoid(x),
+            lambda x: 1.0 / (1.0 + numpy.exp(-x)),
+            lambda y, x: y * (1.0 - y),
+            lambda y, x: y * (1.0 - y)),
+        "relu": Activation(
+            "relu",
+            lambda x: jnp.logaddexp(x, 0.0),
+            _np_softplus,
+            # y = log(1+e^x)  =>  dy/dx = 1 - e^-y
+            lambda y, x: 1.0 - jnp.exp(-y),
+            lambda y, x: 1.0 - numpy.exp(-y)),
+        "strict_relu": Activation(
+            "strict_relu",
+            lambda x: jnp.maximum(x, 0.0),
+            lambda x: numpy.maximum(x, 0.0),
+            lambda y, x: (y > 0).astype(y.dtype),
+            lambda y, x: (y > 0).astype(y.dtype)),
+        "log": Activation(
+            "log",
+            lambda x: jnp.log(x + jnp.sqrt(x * x + 1.0)),
+            lambda x: numpy.log(x + numpy.sqrt(x * x + 1.0)),
+            lambda y, x: 1.0 / jnp.sqrt(x * x + 1.0),
+            lambda y, x: 1.0 / numpy.sqrt(x * x + 1.0)),
+        "tanhlog": Activation(
+            "tanhlog",
+            lambda x: jnp.where(jnp.abs(x) <= 15.0 / B,
+                                A * jnp.tanh(B * x),
+                                jnp.sign(x) * (jnp.log(jnp.abs(x) * B) / B +
+                                               A * jnp.tanh(15.0))),
+            lambda x: numpy.where(numpy.abs(x) <= 15.0 / B,
+                                  A * numpy.tanh(B * x),
+                                  numpy.sign(x) *
+                                  (numpy.log(numpy.abs(x) * B) / B +
+                                   A * numpy.tanh(15.0))),
+            lambda y, x: jnp.where(jnp.abs(x) <= 15.0 / B,
+                                   A * B / jnp.cosh(B * x) ** 2,
+                                   1.0 / (B * jnp.abs(x)) / B),
+            lambda y, x: numpy.where(numpy.abs(x) <= 15.0 / B,
+                                     A * B / numpy.cosh(B * x) ** 2,
+                                     1.0 / (B * numpy.abs(x)) / B)),
+        "sincos": Activation(
+            "sincos",
+            lambda x: jnp.where(
+                jnp.arange(x.shape[-1]) % 2 == 1, jnp.sin(x), jnp.cos(x)),
+            lambda x: numpy.where(
+                numpy.arange(x.shape[-1]) % 2 == 1,
+                numpy.sin(x), numpy.cos(x)),
+            lambda y, x: jnp.where(
+                jnp.arange(x.shape[-1]) % 2 == 1, jnp.cos(x), -jnp.sin(x)),
+            lambda y, x: numpy.where(
+                numpy.arange(x.shape[-1]) % 2 == 1,
+                numpy.cos(x), -numpy.sin(x))),
+    }
+
+
+_table = None
+
+
+def get(name):
+    global _table
+    if _table is None:
+        _table = _make_table()
+    return _table[name]

@@ -1,0 +1,166 @@
+"""Fully-connected (all-to-all) forward units.
+
+Re-creation of ``veles.znicz.all2all`` (absent submodule; inventory per
+SURVEY.md §2.9 / docs manualrst_veles_workflow_parameters.rst:469-504):
+All2All, All2AllTanh, All2AllSigmoid, All2AllRELU (softplus),
+All2AllStrictRELU, All2AllSoftmax, ResizableAll2All.
+
+The matmul is the MXU's native op: ``x @ W + b`` via jnp with weights in
+the natural (in, out) layout; XLA fuses the activation into the matmul
+epilogue.  ``y = act(flatten(x) @ W + b)``.
+"""
+
+import numpy
+
+from ..memory import Array
+from .nn_units import ForwardBase
+from . import activations
+
+
+class All2All(ForwardBase):
+    """Linear fully-connected layer."""
+
+    MAPPING = "all2all"
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        shape = kwargs["output_sample_shape"]
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.output_sample_shape = tuple(shape)
+        self.activation = activations.get(self.ACTIVATION)
+
+    @property
+    def neurons_number(self):
+        return int(numpy.prod(self.output_sample_shape))
+
+    def init_params(self):
+        n_input = int(numpy.prod(self.input_shape[1:]))
+        self.fill_array(self.weights, (n_input, self.neurons_number),
+                        self.weights_stddev, self.weights_filling)
+        if self.include_bias:
+            self.fill_array(self.bias, (self.neurons_number,),
+                            self.bias_stddev, self.bias_filling)
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0],) + self.output_sample_shape
+
+    def apply(self, params, x):
+        import jax.numpy as jnp
+        x = x.reshape(x.shape[0], -1)
+        y = x @ params["weights"]
+        if "bias" in params:
+            y = y + params["bias"]
+        y = self.activation.fwd_jnp(y)
+        if len(self.output_sample_shape) > 1:
+            y = y.reshape((x.shape[0],) + self.output_sample_shape)
+        return y
+
+    def apply_numpy(self, params, x):
+        x = x.reshape(x.shape[0], -1)
+        y = x @ params["weights"]
+        if "bias" in params:
+            y = y + params["bias"]
+        y = self.activation.fwd_np(y)
+        if len(self.output_sample_shape) > 1:
+            y = y.reshape((x.shape[0],) + self.output_sample_shape)
+        return y
+
+
+class All2AllTanh(All2All):
+    """y = 1.7159 * tanh(0.6666 * (xW + b))."""
+    MAPPING = "all2all_tanh"
+    ACTIVATION = "tanh"
+
+
+class All2AllSigmoid(All2All):
+    MAPPING = "all2all_sigmoid"
+    ACTIVATION = "sigmoid"
+
+
+class All2AllRELU(All2All):
+    """Znicz "RELU": y = log(1 + exp(xW + b)) — softplus."""
+    MAPPING = "all2all_relu"
+    ACTIVATION = "relu"
+
+
+class All2AllStrictRELU(All2All):
+    MAPPING = "all2all_str"
+    ACTIVATION = "strict_relu"
+
+
+class All2AllSoftmax(All2All):
+    """Softmax output layer; also exports ``max_idx`` (argmax per sample)
+    the evaluator consumes (reference All2AllSoftmax contract)."""
+
+    MAPPING = "softmax"
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.max_idx = Array()
+
+    def apply(self, params, x):
+        import jax
+        import jax.numpy as jnp
+        x = x.reshape(x.shape[0], -1)
+        logits = x @ params["weights"]
+        if "bias" in params:
+            logits = logits + params["bias"]
+        return jax.nn.softmax(logits, axis=-1)
+
+    def apply_numpy(self, params, x):
+        x = x.reshape(x.shape[0], -1)
+        logits = x @ params["weights"]
+        if "bias" in params:
+            logits = logits + params["bias"]
+        logits = logits - logits.max(axis=-1, keepdims=True)
+        e = numpy.exp(logits)
+        return e / e.sum(axis=-1, keepdims=True)
+
+    def apply_logits(self, params, x):
+        """Pre-softmax logits — the fused trainer uses these with a
+        numerically-stable fused log-softmax cross-entropy."""
+        x = x.reshape(x.shape[0], -1)
+        y = x @ params["weights"]
+        if "bias" in params:
+            y = y + params["bias"]
+        return y
+
+    def tpu_run(self):
+        super().tpu_run()
+        self._fill_max_idx()
+
+    def numpy_run(self):
+        super().numpy_run()
+        self._fill_max_idx()
+
+    def _fill_max_idx(self):
+        self.max_idx.mem = numpy.argmax(
+            self.output.map_read(), axis=-1).astype(numpy.int32)
+
+
+class ResizableAll2All(All2All):
+    """All2All whose output width can grow/shrink mid-training, preserving
+    learned weights (reference resizable_all2all.ResizableAll2All)."""
+
+    MAPPING = "all2all_resizable"
+
+    def resize(self, new_neurons):
+        old_w = self.weights.map_read()
+        old_b = self.bias.map_read() if self.include_bias else None
+        old_n = self.neurons_number
+        self.output_sample_shape = (int(new_neurons),)
+        n_input = old_w.shape[0]
+        self.fill_array(self.weights, (n_input, new_neurons),
+                        self.weights_stddev, self.weights_filling)
+        keep = min(old_n, new_neurons)
+        self.weights.map_write()[:, :keep] = old_w[:, :keep]
+        if self.include_bias:
+            self.fill_array(self.bias, (new_neurons,),
+                            self.bias_stddev, self.bias_filling)
+            self.bias.map_write()[:keep] = old_b[:keep]
+        if self.is_initialized and self.device is not None \
+                and self.device.exists:
+            self.tpu_init()

@@ -1,0 +1,153 @@
+"""Evaluator units: turn network output into a loss gradient + metrics.
+
+Re-creation of ``veles.znicz.evaluator`` (absent; SURVEY.md §2.9):
+EvaluatorSoftmax (cross-entropy, n_err / confusion matrix accounting) and
+EvaluatorMSE (mean-squared error against targets).
+
+Contract with the GD chain: ``err_output`` is the raw loss gradient wrt the
+forward's output *summed over classes, not yet divided by batch size* — the
+GD units divide by batch (mirrors the reference split of responsibilities).
+Padded minibatch rows (beyond ``batch_size``) are masked out of both the
+gradient and the metrics.
+"""
+
+import numpy
+
+from ..memory import Array
+from ..result_provider import IResultProvider
+from .nn_units import NNUnitBase
+
+
+class EvaluatorBase(NNUnitBase, IResultProvider):
+    hide_from_registry = True
+    view_group = "EVALUATOR"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.output = None           # linked: forward's output
+        self.batch_size = None       # linked: loader.minibatch_size
+        self.err_output = Array()
+        self.testing = bool(kwargs.get("testing", False))
+
+    def _mask(self, n_rows):
+        """(max_batch,) float mask of valid rows."""
+        m = numpy.zeros(n_rows, numpy.float32)
+        m[:self.batch_size] = 1
+        return m
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Cross-entropy evaluator for All2AllSoftmax outputs.
+
+    err_output = (y - onehot(labels)) * row_mask; metrics: n_err (running
+    per epoch reset by Decision), confusion_matrix, max_err_output_sum.
+    """
+
+    MAPPING = "evaluator_softmax"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.labels = None           # linked: loader.minibatch_labels
+        self.max_idx = None          # linked: All2AllSoftmax.max_idx
+        self.n_err = Array(numpy.zeros(1, numpy.int64))
+        self.confusion_matrix = Array()
+        self.max_err_output_sum = Array(numpy.zeros(1, numpy.float32))
+        self.loss = None
+        self.compute_confusion_matrix = bool(
+            kwargs.get("compute_confusion_matrix", True))
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        n_classes = self.output.shape[-1]
+        if self.compute_confusion_matrix:
+            self.confusion_matrix.mem = numpy.zeros(
+                (n_classes, n_classes), numpy.int64)
+
+    def run(self):
+        y = self._host(self.output)
+        labels = self._host(self.labels).astype(numpy.int64)
+        bs = int(self.batch_size)
+        n_classes = y.shape[-1]
+        onehot = numpy.zeros_like(y)
+        valid = labels[:bs]
+        onehot[numpy.arange(bs), valid] = 1
+        err = y - onehot
+        err[bs:] = 0
+        self.err_output.mem = err.astype(numpy.float32)
+        pred = self._host(self.max_idx)[:bs] if self.max_idx is not None \
+            else numpy.argmax(y[:bs], axis=-1)
+        errors = int((pred != valid).sum())
+        self.n_err.map_write()[0] += errors
+        eps = 1e-30
+        self.loss = float(
+            -numpy.log(y[numpy.arange(bs), valid] + eps).mean())
+        self.max_err_output_sum.map_write()[0] = max(
+            float(self.max_err_output_sum[0]),
+            float(numpy.abs(err[:bs]).sum(axis=1).max()))
+        if self.compute_confusion_matrix:
+            cm = self.confusion_matrix.map_write()
+            for t, p in zip(valid, pred):
+                cm[p, t] += 1
+
+    @staticmethod
+    def _host(v):
+        if isinstance(v, Array):
+            return v.map_read()
+        return numpy.asarray(v)
+
+    def get_metric_values(self):
+        return {"n_err": int(self.n_err[0]), "loss": self.loss}
+
+    # pure loss for the fused trainer ---------------------------------------
+    @staticmethod
+    def loss_from_logits(logits, labels, mask):
+        """Numerically-stable masked softmax cross-entropy (mean over valid
+        rows) — used by the fused jitted step where the forward supplies
+        logits (All2AllSoftmax.apply_logits)."""
+        import jax
+        import jax.numpy as jnp
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """MSE evaluator (reference EvaluatorMSE): err_output = y - target."""
+
+    MAPPING = "evaluator_mse"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.target = None           # linked: loader.minibatch_targets
+        self.metrics = Array(numpy.zeros(3, numpy.float64))
+        # metrics = [sum squared error, max sample mse, min sample mse]
+        self.metrics.mem[2] = numpy.inf
+        self.n_err = Array(numpy.zeros(1, numpy.int64))
+        self.mse = Array()
+        self.root = bool(kwargs.get("root", True))  # rmse in results
+
+    def run(self):
+        y = EvaluatorSoftmax._host(self.output)
+        t = EvaluatorSoftmax._host(self.target)
+        bs = int(self.batch_size)
+        err = (y - t).reshape(y.shape[0], -1)
+        err[bs:] = 0
+        self.err_output.mem = err.reshape(y.shape).astype(numpy.float32)
+        sample_mse = numpy.sqrt((err[:bs] ** 2).mean(axis=1))
+        self.mse.mem = sample_mse
+        m = self.metrics.map_write()
+        m[0] += float((err[:bs] ** 2).mean(axis=1).sum())
+        m[1] = max(m[1], float(sample_mse.max(initial=0)))
+        m[2] = min(m[2], float(sample_mse.min(initial=numpy.inf)))
+
+    def get_metric_values(self):
+        return {"mse_sum": float(self.metrics[0]),
+                "max_mse": float(self.metrics[1]),
+                "min_mse": float(self.metrics[2])}
+
+    @staticmethod
+    def loss_from_output(y, target, mask):
+        import jax.numpy as jnp
+        err = (y - target).reshape(y.shape[0], -1)
+        per_sample = (err * err).mean(axis=1)
+        return (per_sample * mask).sum() / jnp.maximum(mask.sum(), 1.0)

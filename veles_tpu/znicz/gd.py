@@ -1,0 +1,100 @@
+"""Gradient-descent units for the all2all family.
+
+Re-creation of ``veles.znicz.gd`` (absent; SURVEY.md §2.9):
+GradientDescent, GDTanh, GDSigmoid, GDRELU, GDStrictRELU, GDSoftmax.
+
+Explicit backward math (the activation derivative folded into err_output,
+then one matmul each for grad_W and err_input — the same two GEMMs the
+reference's CUDA kernels issue, here lowered to the MXU by XLA):
+
+    err = err_output * act'(y)
+    grad_W = x^T err / B;  grad_b = mean(err);  err_input = err W^T
+"""
+
+from .nn_units import GradientDescentBase
+from . import activations
+
+
+class GradientDescent(GradientDescentBase):
+    """Backward for linear All2All."""
+
+    MAPPING = "all2all"
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.activation = activations.get(self.ACTIVATION)
+
+    @staticmethod
+    def _linear_bwd(params, x, err, xp):
+        batch = x.shape[0]
+        xf = x.reshape(batch, -1)
+        grads = {"weights": xf.T @ err / batch}
+        if "bias" in params:
+            grads["bias"] = err.mean(axis=0)
+        err_input = (err @ params["weights"].T).reshape(x.shape)
+        return err_input, grads
+
+    def backward(self, params, x, y, err_output):
+        import jax.numpy as jnp
+        err = err_output.reshape(err_output.shape[0], -1)
+        err = err * self.activation.deriv_jnp(
+            y.reshape(err.shape), None)
+        return self._linear_bwd(params, x, err, jnp)
+
+    def backward_numpy(self, params, x, y, err_output):
+        import numpy
+        err = err_output.reshape(err_output.shape[0], -1)
+        err = err * self.activation.deriv_np(y.reshape(err.shape), None)
+        return self._linear_bwd(params, x, err, numpy)
+
+
+class GDTanh(GradientDescent):
+    MAPPING = "all2all_tanh"
+    ACTIVATION = "tanh"
+
+
+class GDSigmoid(GradientDescent):
+    MAPPING = "all2all_sigmoid"
+    ACTIVATION = "sigmoid"
+
+
+class GDRELU(GradientDescent):
+    MAPPING = "all2all_relu"
+    ACTIVATION = "relu"
+
+
+class GDStrictRELU(GradientDescent):
+    MAPPING = "all2all_str"
+    ACTIVATION = "strict_relu"
+
+
+class GDSoftmax(GradientDescent):
+    """Backward for All2AllSoftmax.  The evaluator already emits
+    ``err_output = (y - onehot)/B`` — the exact cross-entropy gradient wrt
+    the logits — so no derivative multiply happens here (reference GDSoftmax
+    contract with EvaluatorSoftmax)."""
+
+    MAPPING = "softmax"
+    ACTIVATION = "linear"
+
+    def backward(self, params, x, y, err_output):
+        import jax.numpy as jnp
+        err = err_output.reshape(err_output.shape[0], -1)
+        return self._linear_bwd(params, x, err, jnp)
+
+    def backward_numpy(self, params, x, y, err_output):
+        import numpy
+        err = err_output.reshape(err_output.shape[0], -1)
+        return self._linear_bwd(params, x, err, numpy)
+
+
+class RPropAll2All(GradientDescent):
+    """All2All trainer with resilient propagation (reference
+    rprop_all2all.RPropAll2All)."""
+
+    MAPPING = "all2all_rprop"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("solver", "rprop")
+        super().__init__(workflow, **kwargs)

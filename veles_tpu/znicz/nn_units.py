@@ -1,0 +1,274 @@
+"""Forward / gradient-descent base units for the NN layer library.
+
+Re-creation of the absent ``veles.znicz.nn_units`` (ForwardBase /
+GradientDescentBase — SURVEY.md §2.9; solver/regularization knobs per
+/root/reference/docs/source/manualrst_veles_algorithms.rst:150-165).
+
+TPU-first contract: every Forward implements
+
+- ``init_params()`` — allocate weights/bias host-side with the unit's
+  reproducible :class:`RandomGenerator` (reference replays RandomState per
+  unit, units.py:859-885);
+- ``apply(params, x)`` — a *pure* function of ``params = {"weights": W,
+  "bias": b}`` usable under jit/grad/vmap/shard_map.  Graph-mode ``run``
+  wraps it; the StandardWorkflow fused step composes the whole chain of
+  ``apply``s into one jitted train step with ``jax.value_and_grad``.
+
+Every GradientDescent unit implements explicit backward math (``numpy_run``
+twin + jitted kernel) so graph mode matches the fused autodiff path — that
+equivalence is asserted by the tests.
+"""
+
+import numpy
+
+from ..accelerated_units import AcceleratedUnit
+from ..memory import Array
+from .. import prng
+from . import solvers
+
+
+class NNUnitBase(AcceleratedUnit):
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.prng = kwargs.get("prng", prng.get())
+
+
+class ForwardBase(NNUnitBase):
+    """Base for forward propagation units (weights + bias + activation)."""
+
+    hide_from_registry = True
+    view_group = "WORKER"
+    MAPPING = None  # StandardWorkflow layer-type key
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input = None               # linked from the previous unit
+        self.output = Array()
+        self.weights = Array()
+        self.bias = Array()
+        self.include_bias = bool(kwargs.get("include_bias", True))
+        self.weights_stddev = kwargs.get("weights_stddev")
+        self.bias_stddev = kwargs.get("bias_stddev",
+                                      kwargs.get("weights_stddev"))
+        self.weights_filling = kwargs.get("weights_filling", "uniform")
+        self.bias_filling = kwargs.get("bias_filling", "uniform")
+        self.exports = ["weights", "bias", "include_bias"]
+
+    # -- parameter handling --------------------------------------------------
+    @property
+    def params(self):
+        """The layer's trainable pytree (device views)."""
+        p = {}
+        if self.weights:
+            p["weights"] = self.weights.devmem
+        if self.include_bias and self.bias:
+            p["bias"] = self.bias.devmem
+        return p
+
+    def set_params(self, params):
+        """Accept fresh device values from the fused step."""
+        if "weights" in params:
+            self.weights.devmem = params["weights"]
+        if "bias" in params:
+            self.bias.devmem = params["bias"]
+
+    def fill_array(self, arr, shape, stddev, filling):
+        n_in = shape[0] if len(shape) > 1 else max(shape[0], 1)
+        if stddev is None:
+            stddev = 1.0 / numpy.sqrt(n_in)
+        mem = numpy.zeros(shape, numpy.float32)
+        if filling == "uniform":
+            self.prng.fill(mem, -stddev, stddev)
+        elif filling == "gaussian":
+            mem[...] = self.prng.normal(0, stddev, shape)
+        elif filling == "constant":
+            mem[...] = stddev
+        else:
+            raise ValueError("unknown filling %r" % filling)
+        arr.mem = mem
+
+    def init_params(self):
+        raise NotImplementedError
+
+    def apply(self, params, x):
+        raise NotImplementedError
+
+    # -- graph-mode execution ------------------------------------------------
+    def output_shape_for(self, input_shape):
+        """Shape of the output for a given input shape; lets initialize
+        pre-allocate ``output`` so downstream units can size themselves
+        before the first run (reference forwards allocate in initialize)."""
+        raise NotImplementedError
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        if not self.weights:
+            self.init_params()
+        out_shape = self.output_shape_for(self.input_shape)
+        if not self.output or tuple(self.output.shape) != tuple(out_shape):
+            self.output.reset(numpy.zeros(out_shape, numpy.float32))
+
+    @property
+    def input_shape(self):
+        v = self.input
+        return v.shape if isinstance(v, Array) else numpy.shape(v)
+
+    def tpu_init(self):
+        import jax
+        self._jitted_ = jax.jit(self.apply)
+
+    def tpu_run(self):
+        x = self.input.devmem if isinstance(self.input, Array) else self.input
+        self.output.devmem = self._jitted_(self.params, x)
+
+    def numpy_run(self):
+        x = self.input.map_read() if isinstance(self.input, Array) \
+            else numpy.asarray(self.input)
+        params = {"weights": self.weights.map_read()}
+        if self.include_bias and self.bias:
+            params["bias"] = self.bias.map_read()
+        self.output.mem = numpy.asarray(self.apply_numpy(params, x))
+
+    def apply_numpy(self, params, x):
+        """Host twin; default falls back to the jnp apply (exact on CPU)."""
+        return self.apply(params, x)
+
+
+class GradientDescentBase(NNUnitBase):
+    """Base for backward/update units.
+
+    Linked attributes (reference GD contract): ``input`` (forward's input),
+    ``output`` (forward's output), ``err_output`` (gradient flowing in from
+    the next layer or the evaluator); produces ``err_input`` and updates the
+    forward's ``weights``/``bias`` in place through a two-way link.
+    """
+
+    hide_from_registry = True
+    view_group = "TRAINER"
+    MAPPING = None
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input = None
+        self.output = None
+        self.err_output = None
+        self.err_input = Array()
+        self.weights = None        # linked two-way with the forward
+        self.bias = None
+        self.forward_unit = None   # set by link_forward / StandardWorkflow
+        self.learning_rate = kwargs.get("learning_rate", 0.01)
+        self.learning_rate_bias = kwargs.get("learning_rate_bias",
+                                             kwargs.get("learning_rate",
+                                                        0.01))
+        self.weights_decay = kwargs.get("weights_decay", 0.0)
+        self.weights_decay_bias = kwargs.get("weights_decay_bias", 0.0)
+        self.l1_vs_l2 = kwargs.get("l1_vs_l2", 0.0)
+        self.l1_vs_l2_bias = kwargs.get("l1_vs_l2_bias",
+                                        kwargs.get("l1_vs_l2", 0.0))
+        self.factor_ortho = kwargs.get("factor_ortho", 0.0)
+        self.gradient_moment = kwargs.get("gradient_moment", 0.0)
+        self.solver_name = kwargs.get(
+            "solver", "momentum" if self.gradient_moment else "sgd")
+        hyper = dict(kwargs.get("solver_parameters", {}))
+        if self.solver_name == "momentum":
+            hyper.setdefault("momentum", self.gradient_moment or 0.9)
+        self.solver = solvers.factory(self.solver_name, **hyper)
+        self.solver_state = {}     # param name -> state tuple
+        self.need_err_input = bool(kwargs.get("need_err_input", True))
+        self.batch_normalize_grad = False
+
+    def link_forward(self, fwd):
+        """Wire the standard attribute set to a forward unit."""
+        self.forward_unit = fwd
+        self.link_attrs(fwd, "input", "output", two_way=False)
+        self.link_attrs(fwd, "weights", "bias", two_way=True)
+        return self
+
+    # -- solver plumbing -----------------------------------------------------
+    def ensure_solver_state(self, params, xp=numpy):
+        for name, p in params.items():
+            if name not in self.solver_state:
+                self.solver_state[name] = self.solver.init(p, xp)
+
+    def lr_for(self, name):
+        return self.learning_rate_bias if name == "bias" \
+            else self.learning_rate
+
+    def decay_for(self, name):
+        if name == "bias":
+            return self.weights_decay_bias, self.l1_vs_l2_bias, 0.0
+        return self.weights_decay, self.l1_vs_l2, self.factor_ortho
+
+    def apply_updates(self, params, grads, xp=numpy):
+        """Pure-ish solver application; returns new params dict and stores
+        new solver state."""
+        self.ensure_solver_state(params, xp)
+        out = {}
+        for name, p in params.items():
+            g = grads[name]
+            decay, l1l2, ortho = self.decay_for(name)
+            g = solvers.regularized_grad(g, p, decay, l1l2, xp, ortho)
+            delta, new_state = self.solver.update(
+                g, p, self.solver_state[name], self.lr_for(name), xp)
+            self.solver_state[name] = new_state
+            out[name] = p + delta
+        return out
+
+    # -- backward interface --------------------------------------------------
+    def backward(self, params, x, y, err_output):
+        """Pure backward: returns (err_input, grads dict).  Gradients are
+        *mean* over the batch (reference divides by batch size)."""
+        raise NotImplementedError
+
+    def numpy_run(self):
+        x = self._host(self.input)
+        y = self._host(self.output)
+        err_out = self._host(self.err_output)
+        params = {"weights": self._host(self.weights)}
+        if self.bias:
+            params["bias"] = self._host(self.bias)
+        err_in, grads = self.backward_numpy(params, x, y, err_out)
+        new_params = self.apply_updates(params, grads, numpy)
+        self.weights.mem = numpy.asarray(new_params["weights"],
+                                         numpy.float32)
+        if self.bias and "bias" in new_params:
+            self.bias.mem = numpy.asarray(new_params["bias"], numpy.float32)
+        if self.need_err_input:
+            self.err_input.mem = numpy.asarray(err_in, numpy.float32)
+
+    def backward_numpy(self, params, x, y, err_output):
+        return self.backward(params, x, y, err_output)
+
+    def tpu_init(self):
+        import jax
+        self._jitted_bwd_ = jax.jit(self.backward)
+
+    def tpu_run(self):
+        import jax.numpy as jnp
+        x = self._dev(self.input)
+        y = self._dev(self.output)
+        err_out = self._dev(self.err_output)
+        params = {"weights": self.weights.devmem}
+        if self.bias:
+            params["bias"] = self.bias.devmem
+        err_in, grads = self._jitted_bwd_(params, x, y, err_out)
+        new_params = self.apply_updates(params, grads, jnp)
+        self.weights.devmem = new_params["weights"]
+        if self.bias and "bias" in new_params:
+            self.bias.devmem = new_params["bias"]
+        if self.need_err_input:
+            self.err_input.devmem = err_in
+
+    @staticmethod
+    def _host(v):
+        if isinstance(v, Array):
+            return v.map_read()
+        return numpy.asarray(v)
+
+    @staticmethod
+    def _dev(v):
+        if isinstance(v, Array):
+            return v.devmem
+        return v
