@@ -1,0 +1,124 @@
+"""StandardWorkflow tests: topology building, fused-vs-graph numerical
+equivalence, and MNIST sample convergence (the §7.5 "minimum end-to-end
+slice" milestone)."""
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.base import TEST, VALID, TRAIN
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+class BlobLoader(FullBatchLoader):
+    def load_data(self):
+        rng = numpy.random.RandomState(4)
+        centers = rng.uniform(-2, 2, (4, 8))
+        data, labels = [], []
+        for c in range(4):
+            data.append(centers[c] + 0.35 * rng.standard_normal((50, 8)))
+            labels += [c] * 50
+        data = numpy.concatenate(data).astype(numpy.float32)
+        order = rng.permutation(len(data))
+        self.original_data.mem = data[order]
+        self.original_labels = list(numpy.array(labels)[order])
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = 50
+        self.class_lengths[TRAIN] = 150
+
+
+LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 20},
+     "<-": {"learning_rate": 0.2, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 4},
+     "<-": {"learning_rate": 0.2, "gradient_moment": 0.9}},
+]
+
+
+def build(fused, max_epochs=8, seed=77):
+    import veles_tpu.prng.random_generator as rg
+    rg._generators.clear()  # deterministic weight init across builds
+    rg.get(0).seed(seed)
+    wf = StandardWorkflow(
+        None, name="std",
+        loader_factory=BlobLoader,
+        loader={"minibatch_size": 25, "prng": RandomGenerator().seed(5)},
+        layers=LAYERS,
+        loss_function="softmax",
+        decision={"max_epochs": max_epochs, "silent": True},
+        fused=fused)
+    wf.initialize(device=Device(backend="cpu"))
+    return wf
+
+
+def test_fused_converges():
+    wf = build(fused=True)
+    wf.run()
+    assert wf.is_finished
+    assert wf.decision.best_n_err_pt < 10.0, wf.decision.best_n_err_pt
+
+
+def test_graph_converges():
+    wf = build(fused=False)
+    wf.run()
+    assert wf.is_finished
+    assert wf.decision.best_n_err_pt < 10.0, wf.decision.best_n_err_pt
+
+
+def test_fused_equals_graph():
+    """The fused jitted step and the explicit unit-graph backward must
+    produce the same trained weights (same seeds, same data)."""
+    wf_f = build(fused=True, max_epochs=3)
+    wf_g = build(fused=False, max_epochs=3)
+    wf_f.run()
+    wf_g.run()
+    for ff, fg in zip(wf_f.forwards, wf_g.forwards):
+        assert numpy.allclose(ff.weights.map_read(), fg.weights.map_read(),
+                              atol=2e-4), type(ff).__name__
+        assert numpy.allclose(ff.bias.map_read(), fg.bias.map_read(),
+                              atol=2e-4)
+    assert wf_f.decision.epoch_n_err_pt[VALID] == \
+        pytest.approx(wf_g.decision.epoch_n_err_pt[VALID], abs=1.0)
+
+
+def test_fused_equals_graph_partial_minibatches():
+    """Equivalence must hold when class lengths don't divide the minibatch
+    size (regression: graph-mode gradients were divided by the padded batch
+    dimension instead of the valid row count)."""
+    import veles_tpu.prng.random_generator as rg
+
+    def build_uneven(fused):
+        rg._generators.clear()
+        rg.get(0).seed(99)
+        wf = StandardWorkflow(
+            None, name="std_uneven",
+            loader_factory=BlobLoader,
+            loader={"minibatch_size": 40,
+                    "prng": RandomGenerator().seed(5)},
+            layers=LAYERS, loss_function="softmax",
+            decision={"max_epochs": 2, "silent": True}, fused=fused)
+        wf.initialize(device=Device(backend="cpu"))
+        return wf
+
+    wf_f, wf_g = build_uneven(True), build_uneven(False)
+    wf_f.run()
+    wf_g.run()
+    for ff, fg in zip(wf_f.forwards, wf_g.forwards):
+        assert numpy.allclose(ff.weights.map_read(), fg.weights.map_read(),
+                              atol=2e-4), type(ff).__name__
+
+
+def test_mnist_sample_converges():
+    """MnistSimple (synthetic twin dataset) must beat the 1.48% baseline
+    analog comfortably."""
+    from veles_tpu.znicz.samples import mnist
+    wf = mnist.create_workflow(
+        loader={"minibatch_size": 60, "n_train": 1500, "n_valid": 400,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 6, "silent": True})
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    assert wf.is_finished
+    assert wf.decision.best_n_err_pt < 5.0, wf.decision.best_n_err_pt

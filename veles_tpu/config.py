@@ -109,6 +109,12 @@ class Config:
     def items(self):
         return [(k, self.__dict__[k]) for k in self.keys()]
 
+    def __getitem__(self, name):
+        try:
+            return self.__dict__[name]
+        except KeyError:
+            raise KeyError("%s.%s" % (self.__dict__["_path"], name))
+
     def __contains__(self, name):
         return name in self.__dict__
 

@@ -1,0 +1,97 @@
+"""Dataset acquisition helpers.
+
+The reference ships a ``Downloader`` unit that fetches datasets at workflow
+init (/root/reference/veles/downloader.py:56) and the Znicz samples load
+MNIST/CIFAR from disk.  This build environment has zero egress, so:
+
+- ``load_mnist()`` reads the standard IDX files when present under
+  ``root.common.dirs.datasets`` (same on-disk format the reference
+  consumes);
+- otherwise it falls back to :func:`synthetic_mnist` — a deterministic
+  MNIST-shaped classification problem (10 smooth class templates + noise +
+  elastic jitter) with the exact array shapes/dtypes of the real thing, so
+  every downstream component (loaders, nets, bench) exercises identically.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy
+
+from .config import root
+
+
+def _dataset_dir():
+    return os.path.expanduser(
+        root.common.dirs.get("datasets", "~/.veles_tpu/datasets"))
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dtype = {0x08: numpy.uint8, 0x09: numpy.int8, 0x0B: numpy.int16,
+                 0x0C: numpy.int32, 0x0D: numpy.float32,
+                 0x0E: numpy.float64}[(magic >> 8) & 0xFF]
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = numpy.frombuffer(f.read(), dtype.newbyteorder(">"))
+        return data.reshape(shape).astype(dtype)
+
+
+def synthetic_mnist(n_train=6000, n_valid=1000, seed=1312, size=28):
+    """Deterministic MNIST-shaped 10-class problem.
+
+    Each class is a smooth random template (low-frequency gaussian field);
+    samples are the template under small shift + pixel noise.  Linearly
+    non-trivial, conv-friendly, and fully reproducible.
+    """
+    rng = numpy.random.RandomState(seed)
+    templates = []
+    for _ in range(10):
+        coarse = rng.uniform(0, 1, (7, 7))
+        fine = numpy.kron(coarse, numpy.ones((4, 4)))[:size, :size]
+        # cheap smoothing: two box-blur passes
+        for _ in range(2):
+            fine = (fine + numpy.roll(fine, 1, 0) + numpy.roll(fine, -1, 0) +
+                    numpy.roll(fine, 1, 1) + numpy.roll(fine, -1, 1)) / 5
+        templates.append(fine)
+    templates = numpy.stack(templates)
+
+    def make(n, rs):
+        labels = rs.randint(0, 10, n)
+        imgs = templates[labels]
+        dx = rs.randint(-2, 3, n)
+        dy = rs.randint(-2, 3, n)
+        out = numpy.empty_like(imgs)
+        for i in range(n):
+            out[i] = numpy.roll(numpy.roll(imgs[i], dx[i], 0), dy[i], 1)
+        out += rs.normal(0, 0.35, out.shape)
+        out = numpy.clip(out, 0, 1.5) / 1.5 * 255
+        return out.astype(numpy.uint8), labels.astype(numpy.int32)
+
+    train = make(n_train, numpy.random.RandomState(seed + 1))
+    valid = make(n_valid, numpy.random.RandomState(seed + 2))
+    return train, valid
+
+
+def load_mnist(n_train=None, n_valid=None):
+    """(train_images, train_labels), (valid_images, valid_labels) as uint8
+    arrays; real MNIST when the IDX files exist, synthetic otherwise.
+    Returns (train, valid, is_real)."""
+    d = os.path.join(_dataset_dir(), "mnist")
+    names = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+             "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+    paths = []
+    for n in names:
+        for cand in (os.path.join(d, n), os.path.join(d, n + ".gz")):
+            if os.path.exists(cand):
+                paths.append(cand)
+                break
+    if len(paths) == 4:
+        ti, tl, vi, vl = (_read_idx(p) for p in paths)
+        return ((ti[:n_train], tl[:n_train].astype(numpy.int32)),
+                (vi[:n_valid], vl[:n_valid].astype(numpy.int32)), True)
+    train, valid = synthetic_mnist(n_train or 6000, n_valid or 1000)
+    return train, valid, False

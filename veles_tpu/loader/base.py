@@ -155,11 +155,16 @@ class Loader(Unit, IResultProvider):
             raise LoaderError(
                 "minibatch_data MUST be initialized in "
                 "create_minibatch_data()")
-        self.analyze_dataset()
-        if not getattr(self.workflow, "restored_from_snapshot", False) \
-                or self.testing:
+        restored = getattr(self.workflow, "restored_from_snapshot", False)
+        if not restored or self.testing:
+            self.analyze_dataset()
             self.shuffle()
-        self._global_offset = 0
+            self._global_offset = 0
+        else:
+            # normalizer state and shuffle order came back with the
+            # snapshot; re-analyzing would double-accumulate — only
+            # re-apply the restored state to the reloaded raw data
+            self.prepare_restored_dataset()
 
     def run(self):
         """Serve one minibatch (standalone mode)."""
@@ -188,12 +193,8 @@ class Loader(Unit, IResultProvider):
             self.epoch_number += 1
             self.shuffle()
         cls = self.class_of_offset(self._global_offset + 1)
-        class_end = self.class_end_offsets[cls]
-        if cls == TRAIN:
-            class_end = (self.class_end_offsets[VALID] +
-                         self.effective_train_length)
         size = min(self.max_minibatch_size,
-                   class_end - self._global_offset)
+                   self._class_end(cls) - self._global_offset)
         self._global_offset += size
         return self._global_offset, size
 
@@ -217,26 +218,36 @@ class Loader(Unit, IResultProvider):
                 self.minibatch_labels.map_write()[self.minibatch_size:] = -1
             self.minibatch_indices.map_write()[self.minibatch_size:] = -1
 
+    def _class_end(self, cls):
+        if cls == TRAIN:
+            return (self.class_end_offsets[VALID] +
+                    self.effective_train_length)
+        return self.class_end_offsets[cls]
+
     def _on_successful_serve(self):
         self.samples_served += self.minibatch_size
-        cls = self.minibatch_class
-        class_end = self.class_end_offsets[cls]
-        if cls == TRAIN:
-            class_end = (self.class_end_offsets[VALID] +
-                         self.effective_train_length)
-        # the class only ends when nothing is still pending or requeued
-        # (reference base.py:863-871) — otherwise a dropped slave's
-        # minibatch would leak into the next epoch's accounting
+        # Flags fire only when no minibatch is pending or requeued
+        # (reference base.py:863-871) — otherwise a dropped slave's job
+        # would leak into the next epoch's accounting.  The class boundary
+        # is judged at the *generator's* position, not the just-completed
+        # job's offset, so out-of-order slave completions still close the
+        # class once the final job drains.
         outstanding = (len(self.failed_minibatches) +
                        sum(len(v) for v in
                            self.pending_minibatches_.values()))
-        self.last_minibatch <<= (self.minibatch_offset >= class_end and
-                                 outstanding == 0)
-        self.train_ended <<= bool(self.last_minibatch) and cls == TRAIN
+        if outstanding:
+            self.last_minibatch <<= False
+            self.train_ended <<= False
+            self.epoch_ended <<= False
+            return
+        cls = self.class_of_offset(self._global_offset)
+        done = self._global_offset >= self._class_end(cls)
+        self.last_minibatch <<= done
+        self.train_ended <<= done and cls == TRAIN
         # epoch ends once the last class with samples completes
         last_cls = TRAIN if self.class_lengths[TRAIN] else (
             VALID if self.class_lengths[VALID] else TEST)
-        self.epoch_ended <<= bool(self.last_minibatch) and cls == last_cls
+        self.epoch_ended <<= done and cls == last_cls
 
     @property
     def class_ended(self):
@@ -245,11 +256,6 @@ class Loader(Unit, IResultProvider):
     # -- normalization analysis (reference base.py:755-800) ------------------
     def analyze_dataset(self):
         if self.class_lengths[TRAIN] == 0:
-            return
-        if getattr(self.workflow, "restored_from_snapshot", False) and \
-                not self.testing:
-            # normalizer state came back with the snapshot; re-analyzing
-            # would double-accumulate and clobber the restored shuffle
             return
         if isinstance(self.normalizer, normalization.StatelessNormalizer):
             self.normalizer.analyze(self.minibatch_data.mem)
@@ -271,6 +277,11 @@ class Loader(Unit, IResultProvider):
             offset += size
         (self._global_offset, self.minibatch_offset,
          self.minibatch_size, self.minibatch_class) = saved
+
+    def prepare_restored_dataset(self):
+        """Re-apply restored normalizer state after a snapshot restore
+        (loaders that bake normalization into a resident dataset
+        override)."""
 
     def normalize_minibatch(self):
         self.normalizer.normalize(

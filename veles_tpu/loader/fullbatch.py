@@ -68,6 +68,11 @@ class FullBatchLoader(Loader):
             self.normalizer.analyze(train.astype(numpy.float64))
         else:
             self.normalizer.analyze(self.original_data.mem)
+        self.prepare_restored_dataset()
+
+    def prepare_restored_dataset(self):
+        """Bake the (current or restored) normalizer state into the
+        resident dataset and build the dense label table."""
         data = self.original_data.map_write().astype(self._dtype)
         if not isinstance(self.normalizer, normalization.NoneNormalizer):
             self.normalizer.normalize(data)
@@ -80,17 +85,24 @@ class FullBatchLoader(Loader):
                 self._dense_labels[i] = self.labels_mapping.setdefault(
                     raw, len(self.labels_mapping))
 
+    def _gather_sources(self):
+        """(resident device source, destination Array) pairs for the jitted
+        gather — the single point subclasses extend."""
+        import jax
+        pairs = [(self.original_data.devmem, self.minibatch_data)]
+        if self.has_labels:
+            pairs.append((jax.device_put(self._dense_labels),
+                          self.minibatch_labels))
+        return pairs
+
     def _device_init(self):
-        """Build the jitted gather over the resident sources.  Sources and
-        their destination Arrays are declared once so both the plain and
-        MSE variants share one fill_indices."""
+        """Build ONE jitted gather over the declared sources (uploads stay
+        resident in HBM; XLA fuses the per-source takes)."""
         import jax
         import jax.numpy as jnp
-        sources = [self.original_data.devmem]  # one upload, stays resident
-        self._gather_targets_ = [self.minibatch_data]
-        if self.has_labels:
-            sources.append(jax.device_put(self._dense_labels))
-            self._gather_targets_.append(self.minibatch_labels)
+        pairs = self._gather_sources()
+        sources = [s for s, _ in pairs]
+        self._gather_targets_ = [t for _, t in pairs]
 
         @jax.jit
         def gather(idx):
@@ -143,25 +155,21 @@ class FullBatchLoaderMSE(FullBatchLoader):
             self._dtype))
 
     def analyze_dataset(self):
+        self.targets_normalizer.analyze(
+            self.original_targets.map_read().astype(self._dtype))
         super().analyze_dataset()
+
+    def prepare_restored_dataset(self):
+        super().prepare_restored_dataset()
         targets = self.original_targets.map_write().astype(self._dtype)
-        self.targets_normalizer.analyze(targets)
         if not isinstance(self.targets_normalizer,
                           normalization.NoneNormalizer):
             self.targets_normalizer.normalize(targets)
         self.original_targets.mem = targets
 
-    def _device_init(self):
-        import jax
-        import jax.numpy as jnp
-        sources = [self.original_data.devmem, self.original_targets.devmem]
-        self._gather_targets_ = [self.minibatch_data,
-                                 self.minibatch_targets]
-
-        @jax.jit
-        def gather(idx):
-            return tuple(jnp.take(src, idx, axis=0) for src in sources)
-        self._gather_ = gather
+    def _gather_sources(self):
+        return [(self.original_data.devmem, self.minibatch_data),
+                (self.original_targets.devmem, self.minibatch_targets)]
 
     def fill_minibatch(self):
         idx = self.minibatch_indices.map_read()[:self.minibatch_size]

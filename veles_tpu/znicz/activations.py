@@ -88,10 +88,10 @@ def _make_table():
                                    A * numpy.tanh(15.0))),
             lambda y, x: jnp.where(jnp.abs(x) <= 15.0 / B,
                                    A * B / jnp.cosh(B * x) ** 2,
-                                   1.0 / (B * jnp.abs(x)) / B),
+                                   1.0 / (B * jnp.abs(x))),
             lambda y, x: numpy.where(numpy.abs(x) <= 15.0 / B,
                                      A * B / numpy.cosh(B * x) ** 2,
-                                     1.0 / (B * numpy.abs(x)) / B)),
+                                     1.0 / (B * numpy.abs(x)))),
         "sincos": Activation(
             "sincos",
             lambda x: jnp.where(

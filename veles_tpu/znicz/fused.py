@@ -1,0 +1,186 @@
+"""FusedTrainStep: the whole forward+loss+backward+update chain as ONE
+jitted, donated step function.
+
+This is the TPU-native collapse of the reference's hot loop (SURVEY.md §3.1:
+one thread-pool dispatch + gate lock per unit per minibatch).  The unit
+graph remains the *build-time* description — forwards and GD configs are
+taken from the same units graph mode uses — but at run time a single
+``jax.jit`` function with donated params/opt-state executes per minibatch:
+
+    (params, opt, x, labels, size) -> (params', opt', loss, n_err)
+
+Buffer donation keeps one copy of the params in HBM; the loss for softmax
+heads uses fused log-softmax cross-entropy on the *logits* (numerically
+stabler and one less HBM round-trip than materializing probabilities).
+Metrics surface through the same ``n_err``/``metrics`` Arrays the
+evaluator exposes, so Decision units work unchanged.
+"""
+
+import numpy
+
+from ..memory import Array
+from ..result_provider import IResultProvider
+from ..units import Unit
+from .. import loader as loader_mod
+from .all2all import All2AllSoftmax
+from .evaluator import EvaluatorSoftmax, EvaluatorMSE
+from . import solvers
+
+
+class FusedTrainStep(Unit, IResultProvider):
+    """One-step fused trainer over a chain of forward units.
+
+    Parameters: ``forwards`` (list of ForwardBase), ``gd_configs`` (list of
+    GradientDescentBase *or* kwargs dicts, one per forward, reverse not
+    required), ``loss`` ("softmax" | "mse").
+    """
+
+    def __init__(self, workflow, forwards, gd_units, loss="softmax",
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "TRAINER"
+        self.forwards = list(forwards)
+        self.gd_units = list(gd_units)
+        assert len(self.gd_units) == len(self.forwards)
+        self.loss_kind = loss
+        # linked from loader:
+        self.minibatch_data = None
+        self.minibatch_labels = None
+        self.minibatch_targets = None
+        self.minibatch_size = None
+        self.minibatch_class = None
+        self.last_minibatch = None
+        # evaluator-compatible metric surface:
+        self.n_err = Array(numpy.zeros(1, numpy.int64))
+        self.metrics = Array(numpy.zeros(3, numpy.float64))
+        self.metrics.mem[2] = numpy.inf
+        self.confusion_matrix = Array()
+        self.loss = None
+        self.output = Array()      # last forward's output (for consumers)
+        self.max_idx = Array()
+
+    def link_loader(self, loader):
+        self.link_attrs(loader, "minibatch_data", "minibatch_labels",
+                        "minibatch_size", "minibatch_class",
+                        "last_minibatch")
+        if hasattr(loader, "minibatch_targets"):
+            self.link_attrs(loader, "minibatch_targets")
+        return self
+
+    # -- jit construction ----------------------------------------------------
+    def initialize(self, device=None, **kwargs):
+        # forwards live outside the control graph in fused mode, so they
+        # have not been initialized by the dependency walk — bring them up
+        # in chain order (shapes propagate input→output)
+        for fwd in self.forwards:
+            if not fwd.is_initialized:
+                fwd.initialize(device=device, **kwargs)
+        super().initialize(**kwargs)
+        self.device = device
+        import jax
+        import jax.numpy as jnp
+
+        forwards = self.forwards
+        gds = self.gd_units
+        loss_kind = self.loss_kind
+        softmax_head = isinstance(forwards[-1], All2AllSoftmax)
+
+        def net_apply(params, x, with_logits):
+            h = x
+            for i, fwd in enumerate(forwards[:-1]):
+                h = fwd.apply(params[i], h)
+            last = forwards[-1]
+            if with_logits and softmax_head:
+                return last.apply_logits(params[-1], h)
+            return last.apply(params[-1], h)
+
+        def loss_fn(params, x, labels_or_targets, mask):
+            out = net_apply(params, x, True)
+            if loss_kind == "softmax":
+                data_loss = EvaluatorSoftmax.loss_from_logits(
+                    out, labels_or_targets, mask)
+            else:
+                data_loss = EvaluatorMSE.loss_from_output(
+                    out, labels_or_targets, mask)
+            return data_loss, out
+
+        def metrics_of(out, labels_or_targets, mask):
+            if loss_kind == "softmax":
+                pred = jnp.argmax(out, axis=-1)
+                return ((pred != labels_or_targets) * mask).sum()
+            err = (out - labels_or_targets).reshape(out.shape[0], -1)
+            return ((err * err).mean(axis=1) * mask).sum()
+
+        def train_step(params, opt, x, y, size):
+            mask = (jnp.arange(x.shape[0]) < size).astype(jnp.float32)
+            (loss, out), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, x, y, mask)
+            new_params, new_opt = [], []
+            for i, gd in enumerate(gds):
+                layer_p, layer_o = {}, {}
+                for name, p in params[i].items():
+                    g = grads[i][name]
+                    decay, l1l2, ortho = gd.decay_for(name)
+                    g = solvers.regularized_grad(g, p, decay, l1l2, jnp,
+                                                 ortho)
+                    delta, st = gd.solver.update(
+                        g, p, opt[i][name], gd.lr_for(name), jnp)
+                    layer_p[name] = p + delta
+                    layer_o[name] = st
+                new_params.append(layer_p)
+                new_opt.append(layer_o)
+            return new_params, new_opt, loss, metrics_of(out, y, mask), out
+
+        def eval_step(params, x, y, size):
+            mask = (jnp.arange(x.shape[0]) < size).astype(jnp.float32)
+            loss, out = loss_fn(params, x, y, mask)
+            return loss, metrics_of(out, y, mask), out
+
+        self._train_step_ = jax.jit(train_step, donate_argnums=(0, 1))
+        self._eval_step_ = jax.jit(eval_step)
+        # copy: the step donates its param buffers, so they must not alias
+        # the forward units' live weight Arrays
+        self._params_ = [
+            {k: jnp.array(v) for k, v in fwd.params.items()}
+            for fwd in forwards]
+        self._opt_ = [
+            {name: gd.solver.init(p, jnp)
+             for name, p in self._params_[i].items()}
+            for i, gd in enumerate(gds)]
+
+    # -- run -----------------------------------------------------------------
+    def run(self):
+        x = self.minibatch_data.devmem
+        if self.loss_kind == "softmax":
+            y = self.minibatch_labels.devmem
+        else:
+            y = self.minibatch_targets.devmem
+        size = int(self.minibatch_size)
+        if self.minibatch_class == loader_mod.TRAIN:
+            (self._params_, self._opt_, loss, metric, out) = \
+                self._train_step_(self._params_, self._opt_, x, y, size)
+        else:
+            loss, metric, out = self._eval_step_(self._params_, x, y, size)
+        self.loss = loss           # device scalars; pulled lazily
+        self._accumulate(metric)
+        self.output.devmem = out
+        if bool(self.last_minibatch):
+            self.sync_weights()
+
+    def _accumulate(self, metric):
+        if self.loss_kind == "softmax":
+            self.n_err.map_write()[0] += int(metric)
+        else:
+            self.metrics.map_write()[0] += float(metric)
+
+    def sync_weights(self):
+        """Reflect the fused params back into the forward units' Arrays.
+        Copies on device (cheap, once per epoch) — the fused buffers get
+        donated by the next step and must not be aliased externally."""
+        import jax.numpy as jnp
+        for fwd, p in zip(self.forwards, self._params_):
+            fwd.set_params({k: jnp.array(v) for k, v in p.items()})
+
+    def get_metric_values(self):
+        return {"n_err": int(self.n_err[0]),
+                "loss": None if self.loss is None else float(self.loss)}

@@ -25,28 +25,36 @@ class GradientDescent(GradientDescentBase):
         super().__init__(workflow, **kwargs)
         self.activation = activations.get(self.ACTIVATION)
 
-    @staticmethod
-    def _linear_bwd(params, x, err, xp):
-        batch = x.shape[0]
-        xf = x.reshape(batch, -1)
-        grads = {"weights": xf.T @ err / batch}
+    def _linear_bwd(self, params, x, err, n_valid, xp):
+        """grad_W = x^T err / n_valid; padded rows are already zero in err
+        (the evaluator masks them), so dividing by the *valid* count keeps
+        partial minibatches consistent with the fused path's mask.sum()."""
+        xf = x.reshape(x.shape[0], -1)
+        grads = {"weights": xf.T @ err / n_valid}
         if "bias" in params:
-            grads["bias"] = err.mean(axis=0)
-        err_input = (err @ params["weights"].T).reshape(x.shape)
+            grads["bias"] = err.sum(axis=0) / n_valid
+        if self.need_err_input:
+            err_input = (err @ params["weights"].T).reshape(x.shape)
+        else:
+            err_input = None  # skip a full GEMM for first-layer units
         return err_input, grads
 
-    def backward(self, params, x, y, err_output):
+    def backward(self, params, x, y, err_output, n_valid=None):
         import jax.numpy as jnp
+        if n_valid is None:
+            n_valid = x.shape[0]
         err = err_output.reshape(err_output.shape[0], -1)
         err = err * self.activation.deriv_jnp(
             y.reshape(err.shape), None)
-        return self._linear_bwd(params, x, err, jnp)
+        return self._linear_bwd(params, x, err, n_valid, jnp)
 
-    def backward_numpy(self, params, x, y, err_output):
+    def backward_numpy(self, params, x, y, err_output, n_valid=None):
         import numpy
+        if n_valid is None:
+            n_valid = x.shape[0]
         err = err_output.reshape(err_output.shape[0], -1)
         err = err * self.activation.deriv_np(y.reshape(err.shape), None)
-        return self._linear_bwd(params, x, err, numpy)
+        return self._linear_bwd(params, x, err, n_valid, numpy)
 
 
 class GDTanh(GradientDescent):
@@ -78,15 +86,19 @@ class GDSoftmax(GradientDescent):
     MAPPING = "softmax"
     ACTIVATION = "linear"
 
-    def backward(self, params, x, y, err_output):
+    def backward(self, params, x, y, err_output, n_valid=None):
         import jax.numpy as jnp
+        if n_valid is None:
+            n_valid = x.shape[0]
         err = err_output.reshape(err_output.shape[0], -1)
-        return self._linear_bwd(params, x, err, jnp)
+        return self._linear_bwd(params, x, err, n_valid, jnp)
 
-    def backward_numpy(self, params, x, y, err_output):
+    def backward_numpy(self, params, x, y, err_output, n_valid=None):
         import numpy
+        if n_valid is None:
+            n_valid = x.shape[0]
         err = err_output.reshape(err_output.shape[0], -1)
-        return self._linear_bwd(params, x, err, numpy)
+        return self._linear_bwd(params, x, err, n_valid, numpy)
 
 
 class RPropAll2All(GradientDescent):

@@ -154,6 +154,8 @@ class GradientDescentBase(NNUnitBase):
         self.input = None
         self.output = None
         self.err_output = None
+        self.batch_size = None     # linked: loader.minibatch_size (valid
+        #                            rows; padded rows carry zero err)
         self.err_input = Array()
         self.weights = None        # linked two-way with the forward
         self.bias = None
@@ -217,10 +219,14 @@ class GradientDescentBase(NNUnitBase):
         return out
 
     # -- backward interface --------------------------------------------------
-    def backward(self, params, x, y, err_output):
+    def backward(self, params, x, y, err_output, n_valid=None):
         """Pure backward: returns (err_input, grads dict).  Gradients are
-        *mean* over the batch (reference divides by batch size)."""
+        the mean over the *valid* rows (padded rows carry zero error)."""
         raise NotImplementedError
+
+    def _n_valid(self, x):
+        return int(self.batch_size) if self.batch_size is not None \
+            else x.shape[0]
 
     def numpy_run(self):
         x = self._host(self.input)
@@ -229,7 +235,8 @@ class GradientDescentBase(NNUnitBase):
         params = {"weights": self._host(self.weights)}
         if self.bias:
             params["bias"] = self._host(self.bias)
-        err_in, grads = self.backward_numpy(params, x, y, err_out)
+        err_in, grads = self.backward_numpy(params, x, y, err_out,
+                                            self._n_valid(x))
         new_params = self.apply_updates(params, grads, numpy)
         self.weights.mem = numpy.asarray(new_params["weights"],
                                          numpy.float32)
@@ -238,12 +245,13 @@ class GradientDescentBase(NNUnitBase):
         if self.need_err_input:
             self.err_input.mem = numpy.asarray(err_in, numpy.float32)
 
-    def backward_numpy(self, params, x, y, err_output):
-        return self.backward(params, x, y, err_output)
+    def backward_numpy(self, params, x, y, err_output, n_valid=None):
+        return self.backward(params, x, y, err_output, n_valid)
 
     def tpu_init(self):
         import jax
-        self._jitted_bwd_ = jax.jit(self.backward)
+        # n_valid stays static (bounded set of sizes → bounded retraces)
+        self._jitted_bwd_ = jax.jit(self.backward, static_argnames="n_valid")
 
     def tpu_run(self):
         import jax.numpy as jnp
@@ -253,7 +261,8 @@ class GradientDescentBase(NNUnitBase):
         params = {"weights": self.weights.devmem}
         if self.bias:
             params["bias"] = self.bias.devmem
-        err_in, grads = self._jitted_bwd_(params, x, y, err_out)
+        err_in, grads = self._jitted_bwd_(params, x, y, err_out,
+                                          n_valid=self._n_valid(x))
         new_params = self.apply_updates(params, grads, jnp)
         self.weights.devmem = new_params["weights"]
         if self.bias and "bias" in new_params:

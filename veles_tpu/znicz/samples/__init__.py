@@ -1,0 +1,2 @@
+"""Sample model workflows (the Znicz samples inventory — SURVEY.md §2.9:
+MNIST, MnistSimple, MnistAE, CIFAR10, AlexNet, Kohonen, Lines...)."""

@@ -1,0 +1,77 @@
+"""MnistSimple: fully-connected MNIST classifier.
+
+Re-creation of the Znicz MnistSimple sample (absent submodule; topology and
+its published baseline — 1.48 % validation error with a 100-tanh + 10-softmax
+net — from /root/reference/docs/source/manualrst_veles_algorithms.rst:25-31).
+
+Follows the reference's sample convention: the module exposes
+``run(load, main)`` for the CLI (`python -m veles_tpu mnist.py config.py`)
+plus a direct :func:`create_workflow` for programmatic use.
+"""
+
+import numpy
+
+from ...config import root
+from ...loader.fullbatch import FullBatchLoader
+from ...loader.base import TEST, VALID, TRAIN
+from ...datasets import load_mnist
+from ..standard_workflow import StandardWorkflow
+
+root.mnist.update({
+    "loader": {"minibatch_size": 60, "normalization_type": "range_linear"},
+    "layers": [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 100},
+         "<-": {"learning_rate": 0.03, "weights_decay": 0.0,
+                "gradient_moment": 0.9}},
+        {"type": "softmax", "->": {"output_sample_shape": 10},
+         "<-": {"learning_rate": 0.03, "weights_decay": 0.0,
+                "gradient_moment": 0.9}},
+    ],
+    "decision": {"max_epochs": 25, "fail_iterations": 50},
+})
+
+
+class MnistLoader(FullBatchLoader):
+    """MNIST (real IDX files when present, synthetic twin otherwise)."""
+
+    MAPPING = "mnist_loader"
+
+    def __init__(self, workflow, **kwargs):
+        self.n_train = kwargs.pop("n_train", None)
+        self.n_valid = kwargs.pop("n_valid", None)
+        super().__init__(workflow, **kwargs)
+
+    def load_data(self):
+        (ti, tl), (vi, vl), self.is_real = load_mnist(
+            self.n_train, self.n_valid)
+        data = numpy.concatenate([vi, ti]).astype(numpy.float32)
+        self.original_data.mem = data.reshape(len(data), -1)
+        self.original_labels = list(numpy.concatenate([vl, tl]))
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = len(vi)
+        self.class_lengths[TRAIN] = len(ti)
+
+
+def create_workflow(fused=True, **overrides):
+    cfg = root.mnist
+    decision = cfg.decision.todict()
+    decision.update(overrides.get("decision", {}))
+    loader = cfg.loader.todict()
+    loader.update(overrides.get("loader", {}))
+    return StandardWorkflow(
+        None,
+        name="MnistSimple",
+        loader_factory=MnistLoader,
+        loader=loader,
+        layers=overrides.get("layers", cfg.layers),
+        loss_function="softmax",
+        decision=decision,
+        fused=fused,
+    )
+
+
+def run(load, main):
+    """CLI convention (reference manualrst_veles_workflow_creation.rst:
+    30-39): the framework calls run(load, main)."""
+    load(create_workflow)
+    main()

@@ -1,0 +1,191 @@
+"""StandardWorkflow: config-driven NN training topology builder.
+
+Re-creation of ``veles.znicz.standard_workflow.StandardWorkflow`` (absent;
+documented at /root/reference/docs/source/
+manualrst_veles_workflow_creation.rst:101-146): builds
+repeater → loader → forwards[] → evaluator → decision → gds[] (reverse) →
+loop from a ``layers`` config list, each entry
+``{"type": <MAPPING>, "->": {forward kwargs}, "<-": {gd kwargs}}`` (flat
+kwargs are accepted too and routed by prefix knowledge).
+
+Two execution modes:
+
+- **fused** (default on a real device): forwards trace into ONE jitted,
+  donated train-step (:class:`FusedTrainStep`); the graph carries only the
+  host-side control units (loader → fused → decision).  This is the
+  TPU-idiomatic hot loop (SURVEY.md §7).
+- **graph**: the classic per-unit chain with explicit GD units — the
+  parity/debug path, and the shape the reference actually executes.
+
+Both modes share the same forward units, weights, and decision logic, so a
+workflow can be built fused for speed and inspected per-unit.
+"""
+
+from ..plumbing import Repeater
+from ..registry import UnitRegistry
+from ..workflow import Workflow
+from .nn_units import ForwardBase, GradientDescentBase
+from .all2all import All2AllSoftmax
+from .evaluator import EvaluatorSoftmax, EvaluatorMSE
+from .decision import DecisionGD, DecisionMSE
+from .fused import FusedTrainStep
+
+
+def _find_pair(type_name):
+    """Resolve a layer-type MAPPING to its (forward, gd) classes via the
+    unit registry (the reference resolves through its own MAPPING registry,
+    manualrst_veles_workflow_parameters.rst:469)."""
+    fwd = gd = None
+    for cls in UnitRegistry.units.values():
+        if getattr(cls, "MAPPING", None) != type_name:
+            continue
+        if issubclass(cls, ForwardBase):
+            fwd = cls
+        elif issubclass(cls, GradientDescentBase):
+            gd = cls
+    if fwd is None:
+        raise ValueError("unknown layer type %r" % type_name)
+    return fwd, gd
+
+
+class StandardWorkflow(Workflow):
+    """repeater → loader → forwards → evaluator → decision → gds → loop."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.layers_config = list(kwargs.get("layers", ()))
+        self.loss_function = kwargs.get("loss_function", "softmax")
+        self.fused = kwargs.get("fused", True)
+        self.decision_config = dict(kwargs.get("decision", {}))
+        self.loader_config = dict(kwargs.get("loader", {}))
+        loader_factory = kwargs.get("loader_factory")
+        if loader_factory is None:
+            raise ValueError("StandardWorkflow requires loader_factory")
+        self.repeater = Repeater(self)
+        self.loader = loader_factory(self, **self.loader_config)
+        self.forwards = []
+        self.gds = []
+        self.fused_step = None
+        self.evaluator = None
+        self.decision = None
+        self._build()
+
+    # -- construction --------------------------------------------------------
+    def _split_layer_config(self, cfg):
+        cfg = dict(cfg)
+        type_name = cfg.pop("type")
+        fwd_kwargs = dict(cfg.pop("->", {}))
+        gd_kwargs = dict(cfg.pop("<-", {}))
+        # flat keys: route the known GD hyperparameters, rest to forward
+        gd_keys = {"learning_rate", "learning_rate_bias", "weights_decay",
+                   "weights_decay_bias", "l1_vs_l2", "l1_vs_l2_bias",
+                   "gradient_moment", "solver", "solver_parameters",
+                   "factor_ortho"}
+        for k, v in cfg.items():
+            (gd_kwargs if k in gd_keys else fwd_kwargs).setdefault(k, v)
+        return type_name, fwd_kwargs, gd_kwargs
+
+    def _build(self):
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+
+        prev = self.loader
+        gd_pairs = []
+        for cfg in self.layers_config:
+            type_name, fwd_kwargs, gd_kwargs = self._split_layer_config(cfg)
+            fwd_cls, gd_cls = _find_pair(type_name)
+            fwd = fwd_cls(self, **fwd_kwargs)
+            fwd.link_from(prev)
+            if prev is self.loader:
+                fwd.link_attrs(self.loader, ("input", "minibatch_data"))
+            else:
+                fwd.link_attrs(prev, ("input", "output"))
+            self.forwards.append(fwd)
+            gd_pairs.append((gd_cls, gd_kwargs))
+            prev = fwd
+
+        # evaluator (graph mode only — fused mode computes the loss and
+        # metrics inside the step) + decision
+        if self.loss_function == "softmax":
+            if not self.fused:
+                self.evaluator = EvaluatorSoftmax(self)
+            self.decision = DecisionGD(self, **self.decision_config)
+        else:
+            if not self.fused:
+                self.evaluator = EvaluatorMSE(self)
+            self.decision = DecisionMSE(self, **self.decision_config)
+
+        # instantiate GD units (shared by both modes: they own solver state
+        # and hyperparameters; fused mode reads them, graph mode runs them)
+        for (gd_cls, gd_kwargs), fwd in zip(gd_pairs, self.forwards):
+            if gd_cls is None:
+                raise ValueError("no GD unit for layer %r" %
+                                 type(fwd).MAPPING)
+            gd = gd_cls(self, **gd_kwargs)
+            gd.link_forward(fwd)
+            self.gds.append(gd)
+
+        if self.fused:
+            self._build_fused()
+        else:
+            self._build_graph()
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.gate_block = ~self.decision.complete
+
+    def _build_fused(self):
+        # forwards/gds stay OUT of the control graph: FusedTrainStep traces
+        # through them
+        for fwd in self.forwards:
+            fwd.unlink_all()
+        self.fused_step = FusedTrainStep(
+            self, self.forwards, self.gds, loss=self.loss_function)
+        self.fused_step.link_from(self.loader)
+        self.fused_step.link_loader(self.loader)
+        self.decision.link_from(self.fused_step)
+        self.decision.link_loader(self.loader)
+        self.decision.link_evaluator(self.fused_step)
+        self.repeater.link_from(self.decision)
+        self.end_point.link_from(self.decision)
+
+    def _build_graph(self):
+        last_fwd = self.forwards[-1]
+        self.evaluator.link_from(last_fwd)
+        self.evaluator.link_attrs(last_fwd, "output")
+        if isinstance(last_fwd, All2AllSoftmax):
+            self.evaluator.link_attrs(last_fwd, "max_idx")
+        if self.loss_function == "softmax":
+            self.evaluator.link_attrs(
+                self.loader, ("labels", "minibatch_labels"),
+                ("batch_size", "minibatch_size"))
+        else:
+            self.evaluator.link_attrs(
+                self.loader, ("target", "minibatch_targets"),
+                ("batch_size", "minibatch_size"))
+        self.decision.link_from(self.evaluator)
+        self.decision.link_loader(self.loader)
+        self.decision.link_evaluator(self.evaluator)
+
+        prev = self.decision
+        train_gate = self.make_train_gate(self.loader)
+        for i in reversed(range(len(self.forwards))):
+            gd = self.gds[i]
+            gd.link_from(prev)
+            gd.link_attrs(self.loader, ("batch_size", "minibatch_size"))
+            if i == len(self.forwards) - 1:
+                gd.link_attrs(self.evaluator, "err_output")
+            else:
+                gd.link_attrs(self.gds[i + 1], ("err_output", "err_input"))
+            if i == 0:
+                gd.need_err_input = False  # nothing below to backprop into
+            gd.gate_skip = train_gate
+            prev = gd
+        self.repeater.link_from(prev)
+        self.end_point.link_from(prev)
+
+    def run(self):
+        result = super().run()
+        if self.fused_step is not None:
+            self.fused_step.sync_weights()
+        return result
