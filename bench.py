@@ -1,0 +1,63 @@
+"""Benchmark harness: flagship-model training throughput on the real chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Flagship today: MnistSimple fused train step (images/sec/chip).  Once the
+conv stack lands this switches to the AlexNet DP workflow per BASELINE.json.
+``BASELINE_VALUE`` is the recorded round-1 number on one v5e chip;
+``vs_baseline`` is measured/BASELINE_VALUE so improvements show directly.
+"""
+
+import json
+import sys
+import time
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# images/sec recorded for this bench on one v5e chip at round 1 (the
+# reference publishes no throughput numbers — SURVEY.md §6 — so the first
+# TPU measurement anchors the scale)
+BASELINE_VALUE = 16_900.0
+
+
+def bench_mnist(batch=512, steps=60, warmup=10):
+    from veles_tpu.backends import Device
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.znicz.samples import mnist
+    from veles_tpu import loader as loader_mod
+
+    wf = mnist.create_workflow(
+        loader={"minibatch_size": batch, "n_train": batch * 8,
+                "n_valid": batch, "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 10 ** 9, "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    loader, step = wf.loader, wf.fused_step
+
+    def one_train_step():
+        while True:
+            loader.run()
+            if loader.minibatch_class == loader_mod.TRAIN:
+                break
+        step.run()
+
+    for _ in range(warmup):
+        one_train_step()
+    import jax
+    jax.block_until_ready(step._params_)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        one_train_step()
+    jax.block_until_ready(step._params_)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+if __name__ == "__main__":
+    value = bench_mnist()
+    print(json.dumps({
+        "metric": "mnist_fc_train_images_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / BASELINE_VALUE, 3),
+    }))

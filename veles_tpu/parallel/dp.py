@@ -1,0 +1,79 @@
+"""DistributedTrainStep: the fused train step sharded over a device mesh.
+
+This is the TPU-native replacement for the reference's master–slave
+data-parallel trainer (SURVEY.md §2.4): instead of slaves shipping pickled
+gradients to a master over ZeroMQ (server.py:401-414), the batch is sharded
+over the mesh's ``data`` axis, params are replicated (or sharded over
+``model`` for tensor parallelism), and XLA inserts the gradient all-reduce
+(psum over ICI) from the sharding annotations — the same jitted step, now
+SPMD.
+
+The synchronous all-reduce changes the *semantics* vs the reference's
+asynchronous staleness-1 updates: every step sees the freshest weights,
+which is strictly stronger; the reference's elastic join/leave semantics
+move to checkpoint-restart (veles_tpu.distributed) because ICI collectives
+are gang-scheduled (SURVEY.md §7 hard parts).
+"""
+
+from ..znicz.fused import FusedTrainStep
+from . import mesh as mesh_mod
+
+
+class DistributedTrainStep(FusedTrainStep):
+    """FusedTrainStep over a Mesh: batch on ``data``, params replicated
+    (optionally tensor-sharded over ``model``)."""
+
+    def __init__(self, workflow, forwards, gd_units, mesh,
+                 loss="softmax", data_axis="data", model_axis=None,
+                 **kwargs):
+        super().__init__(workflow, forwards, gd_units, loss=loss, **kwargs)
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        m = self.mesh
+        if self.model_axis and self.model_axis in m.shape:
+            param_shard = mesh_mod.tensor_parallel_sharding(
+                m, self._params_, self.model_axis)
+        else:
+            param_shard = mesh_mod.data_parallel_sharding(m, self._params_)
+        # opt state shards like its param (momentum buffers are
+        # param-shaped; adadelta tuples too)
+        opt_shard = [
+            {name: tuple(param_shard[i][name]
+                         for _ in range(len(self._opt_[i][name])))
+             if isinstance(self._opt_[i][name], tuple)
+             else param_shard[i][name]
+             for name in self._opt_[i]}
+            for i in range(len(self._opt_))]
+        batch_shard = mesh_mod.batch_sharding(m, self.data_axis)
+        label_shard = batch_shard
+        scalar = NamedSharding(m, P())
+
+        self._params_ = jax.device_put(self._params_, param_shard)
+        self._opt_ = jax.device_put(self._opt_, opt_shard)
+
+        # re-jit the two steps with explicit shardings; XLA lowers the
+        # gradient reduction to an ICI all-reduce
+        raw_train = self._train_step_.__wrapped__
+        raw_eval = self._eval_step_.__wrapped__
+        self._macc_ = jax.device_put(self._macc_, scalar)
+        self._train_step_ = jax.jit(
+            raw_train,
+            in_shardings=(param_shard, opt_shard, scalar, batch_shard,
+                          label_shard),
+            out_shardings=(param_shard, opt_shard, scalar, scalar,
+                           batch_shard),
+            static_argnums=(5,),
+            donate_argnums=(0, 1, 2))
+        self._eval_step_ = jax.jit(
+            raw_eval,
+            in_shardings=(param_shard, scalar, batch_shard, label_shard),
+            out_shardings=(scalar, scalar, batch_shard),
+            static_argnums=(4,),
+            donate_argnums=(1,))

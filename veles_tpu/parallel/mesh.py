@@ -1,0 +1,74 @@
+"""Mesh construction and sharding helpers.
+
+The mental model follows the public scaling playbook: pick a mesh, annotate
+shardings on params and batch, let XLA insert the collectives, profile,
+iterate.  Axis conventions:
+
+- ``data``  — batch (data parallelism; gradient psum over this axis)
+- ``model`` — hidden/feature dims (tensor parallelism)
+- ``seq``   — sequence dim (context parallelism / ring attention)
+
+A mesh is laid out so ``data`` spans the slowest-varying device dimension
+(DCN across slices in a real pod) and ``model`` the fastest (ICI
+neighbors).
+"""
+
+import numpy
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a Mesh from ``{"axis": size}``; sizes must multiply to the
+    device count (one axis may be -1 to absorb the remainder)."""
+    import jax
+    from jax.sharding import Mesh
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    axes = dict(axes or {"data": n})
+    names, sizes = list(axes.keys()), list(axes.values())
+    if -1 in sizes:
+        known = int(numpy.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    if int(numpy.prod(sizes)) != n:
+        raise ValueError("mesh %s does not cover %d devices" %
+                         (dict(zip(names, sizes)), n))
+    dev_array = numpy.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def batch_sharding(mesh, data_axis="data"):
+    """Sharding for a [batch, ...] array: split the leading dim."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P(data_axis))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())
+
+
+def data_parallel_sharding(mesh, params_tree):
+    """Replicate every param (pure DP)."""
+    import jax
+    rep = replicated(mesh)
+    return jax.tree.map(lambda _: rep, params_tree)
+
+
+def tensor_parallel_sharding(mesh, params_tree, model_axis="model"):
+    """Column-split tensor parallelism: 2-D weights split their *output*
+    dim on ``model`` (and matching 1-D biases likewise); everything else
+    replicates.  XLA then gathers activations before the next layer's
+    matmul — one collective per layer.  (A Megatron alternating
+    column/row scheme would halve the collectives; tracked as a future
+    optimization.)"""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def spec(p):
+        if getattr(p, "ndim", 0) == 2 and p.shape[1] % mesh.shape[
+                model_axis] == 0:
+            return NamedSharding(mesh, P(None, model_axis))
+        if getattr(p, "ndim", 0) == 1 and p.shape[0] % mesh.shape[
+                model_axis] == 0:
+            return NamedSharding(mesh, P(model_axis))
+        return NamedSharding(mesh, P())
+    import jax
+    return jax.tree.map(spec, params_tree)

@@ -106,12 +106,14 @@ class FusedTrainStep(Unit, IResultProvider):
 
         def metrics_of(out, labels_or_targets, mask):
             if loss_kind == "softmax":
+                # exact integer count (float32 would lose counts past 2^24)
                 pred = jnp.argmax(out, axis=-1)
-                return ((pred != labels_or_targets) * mask).sum()
+                wrong = (pred != labels_or_targets) & (mask > 0)
+                return wrong.astype(jnp.int32).sum()
             err = (out - labels_or_targets).reshape(out.shape[0], -1)
             return ((err * err).mean(axis=1) * mask).sum()
 
-        def train_step(params, opt, x, y, size):
+        def train_step(params, opt, macc, x, y, size):
             mask = (jnp.arange(x.shape[0]) < size).astype(jnp.float32)
             (loss, out), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, x, y, mask)
@@ -129,15 +131,24 @@ class FusedTrainStep(Unit, IResultProvider):
                     layer_o[name] = st
                 new_params.append(layer_p)
                 new_opt.append(layer_o)
-            return new_params, new_opt, loss, metrics_of(out, y, mask), out
+            macc = macc + metrics_of(out, y, mask)
+            return new_params, new_opt, macc, loss, out
 
-        def eval_step(params, x, y, size):
+        def eval_step(params, macc, x, y, size):
             mask = (jnp.arange(x.shape[0]) < size).astype(jnp.float32)
             loss, out = loss_fn(params, x, y, mask)
-            return loss, metrics_of(out, y, mask), out
+            return macc + metrics_of(out, y, mask), loss, out
 
-        self._train_step_ = jax.jit(train_step, donate_argnums=(0, 1))
-        self._eval_step_ = jax.jit(eval_step)
+        # the metric accumulator stays ON DEVICE between steps and is
+        # flushed to the host only at class boundaries — per-step int()
+        # pulls would serialize the pipeline on a device sync.  int32 for
+        # error counts (exact); float32 for mse sums (flushed per class,
+        # so drift stays bounded by one epoch)
+        self._macc_dtype = (jnp.int32 if loss_kind == "softmax"
+                            else jnp.float32)
+        self._macc_ = jnp.zeros((), self._macc_dtype)
+        self._train_step_ = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        self._eval_step_ = jax.jit(eval_step, donate_argnums=(1,))
         # copy: the step donates its param buffers, so they must not alias
         # the forward units' live weight Arrays
         self._params_ = [
@@ -157,21 +168,28 @@ class FusedTrainStep(Unit, IResultProvider):
             y = self.minibatch_targets.devmem
         size = int(self.minibatch_size)
         if self.minibatch_class == loader_mod.TRAIN:
-            (self._params_, self._opt_, loss, metric, out) = \
-                self._train_step_(self._params_, self._opt_, x, y, size)
+            (self._params_, self._opt_, self._macc_, loss, out) = \
+                self._train_step_(self._params_, self._opt_, self._macc_,
+                                  x, y, size)
         else:
-            loss, metric, out = self._eval_step_(self._params_, x, y, size)
+            self._macc_, loss, out = self._eval_step_(
+                self._params_, self._macc_, x, y, size)
         self.loss = loss           # device scalars; pulled lazily
-        self._accumulate(metric)
         self.output.devmem = out
         if bool(self.last_minibatch):
+            self._flush_metrics()
             self.sync_weights()
 
-    def _accumulate(self, metric):
+    def _flush_metrics(self):
+        """Pull the device accumulator into the evaluator-compatible
+        Arrays (one sync per class boundary, not per step)."""
+        import jax.numpy as jnp
+        value = float(self._macc_)
+        self._macc_ = jnp.zeros((), self._macc_dtype)
         if self.loss_kind == "softmax":
-            self.n_err.map_write()[0] += int(metric)
+            self.n_err.map_write()[0] += int(round(value))
         else:
-            self.metrics.map_write()[0] += float(metric)
+            self.metrics.map_write()[0] += value
 
     def sync_weights(self):
         """Reflect the fused params back into the forward units' Arrays.

@@ -58,6 +58,8 @@ class StandardWorkflow(Workflow):
         self.layers_config = list(kwargs.get("layers", ()))
         self.loss_function = kwargs.get("loss_function", "softmax")
         self.fused = kwargs.get("fused", True)
+        self.mesh = kwargs.get("mesh")           # jax.sharding.Mesh → SPMD
+        self.model_axis = kwargs.get("model_axis")
         self.decision_config = dict(kwargs.get("decision", {}))
         self.loader_config = dict(kwargs.get("loader", {}))
         loader_factory = kwargs.get("loader_factory")
@@ -139,8 +141,14 @@ class StandardWorkflow(Workflow):
         # through them
         for fwd in self.forwards:
             fwd.unlink_all()
-        self.fused_step = FusedTrainStep(
-            self, self.forwards, self.gds, loss=self.loss_function)
+        if self.mesh is not None:
+            from ..parallel.dp import DistributedTrainStep
+            self.fused_step = DistributedTrainStep(
+                self, self.forwards, self.gds, mesh=self.mesh,
+                loss=self.loss_function, model_axis=self.model_axis)
+        else:
+            self.fused_step = FusedTrainStep(
+                self, self.forwards, self.gds, loss=self.loss_function)
         self.fused_step.link_from(self.loader)
         self.fused_step.link_loader(self.loader)
         self.decision.link_from(self.fused_step)
