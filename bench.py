@@ -18,39 +18,33 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # images/sec recorded for this bench on one v5e chip at round 1 (the
 # reference publishes no throughput numbers — SURVEY.md §6 — so the first
 # TPU measurement anchors the scale)
-BASELINE_VALUE = 16_900.0
+BASELINE_VALUE = 1_450_000.0
 
 
-def bench_mnist(batch=512, steps=60, warmup=10):
+def bench_mnist(batch=512, epochs=24, warmup=4, n_train=16384):
+    """Bulk epoch-scan training throughput (one dispatch per epoch block)."""
     from veles_tpu.backends import Device
     from veles_tpu.prng import RandomGenerator
     from veles_tpu.znicz.samples import mnist
-    from veles_tpu import loader as loader_mod
 
     wf = mnist.create_workflow(
-        loader={"minibatch_size": batch, "n_train": batch * 8,
+        loader={"minibatch_size": batch, "n_train": n_train,
                 "n_valid": batch, "prng": RandomGenerator().seed(3)},
-        decision={"max_epochs": 10 ** 9, "silent": True})
+        decision={"max_epochs": 10 ** 9, "silent": True},
+        epoch_scan=True)
     wf.initialize(device=Device(backend="auto"))
-    loader, step = wf.loader, wf.fused_step
+    step = wf.fused_step
 
-    def one_train_step():
-        while True:
-            loader.run()
-            if loader.minibatch_class == loader_mod.TRAIN:
-                break
-        step.run()
-
-    for _ in range(warmup):
-        one_train_step()
     import jax
+    # warmup with the SAME epoch-block size: a different scan length would
+    # recompile inside the timed region
+    step.train_epochs(epochs)
     jax.block_until_ready(step._params_)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        one_train_step()
+    step.train_epochs(epochs)
     jax.block_until_ready(step._params_)
     dt = time.perf_counter() - t0
-    return batch * steps / dt
+    return n_train * epochs / dt
 
 
 if __name__ == "__main__":

@@ -37,18 +37,19 @@ LAYERS = [
 ]
 
 
-def build(fused, max_epochs=8, seed=77):
+def build(fused, max_epochs=8, seed=77, minibatch=25, **extra):
     import veles_tpu.prng.random_generator as rg
     rg._generators.clear()  # deterministic weight init across builds
     rg.get(0).seed(seed)
     wf = StandardWorkflow(
         None, name="std",
         loader_factory=BlobLoader,
-        loader={"minibatch_size": 25, "prng": RandomGenerator().seed(5)},
+        loader={"minibatch_size": minibatch,
+                "prng": RandomGenerator().seed(5)},
         layers=LAYERS,
         loss_function="softmax",
         decision={"max_epochs": max_epochs, "silent": True},
-        fused=fused)
+        fused=fused, **extra)
     wf.initialize(device=Device(backend="cpu"))
     return wf
 
@@ -108,6 +109,25 @@ def test_fused_equals_graph_partial_minibatches():
     for ff, fg in zip(wf_f.forwards, wf_g.forwards):
         assert numpy.allclose(ff.weights.map_read(), fg.weights.map_read(),
                               atol=2e-4), type(ff).__name__
+
+
+@pytest.mark.parametrize("minibatch", [25, 40])
+def test_epoch_scan_equals_per_step(minibatch):
+    """One-dispatch-per-class lax.scan mode must produce the same weights
+    and decisions as the per-minibatch fused step (even with a partial
+    tail batch)."""
+    wf_s = build(fused=True, max_epochs=3, minibatch=minibatch,
+                 epoch_scan=True)
+    wf_p = build(fused=True, max_epochs=3, minibatch=minibatch)
+    wf_s.run()
+    wf_p.run()
+    for fs, fp in zip(wf_s.forwards, wf_p.forwards):
+        assert numpy.allclose(fs.weights.map_read(), fp.weights.map_read(),
+                              atol=1e-5), type(fs).__name__
+    assert wf_s.decision.best_n_err_pt == \
+        pytest.approx(wf_p.decision.best_n_err_pt, abs=1e-9)
+    assert wf_s.decision.best_epoch == wf_p.decision.best_epoch
+    assert wf_s.loader.epoch_number == wf_p.loader.epoch_number
 
 
 def test_mnist_sample_converges():

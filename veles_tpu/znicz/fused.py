@@ -184,6 +184,12 @@ class FusedTrainStep(Unit, IResultProvider):
         """Pull the device accumulator into the evaluator-compatible
         Arrays (one sync per class boundary, not per step)."""
         import jax.numpy as jnp
+        try:
+            # async D2H then read: avoids the synchronous-transfer RPC
+            # penalty on tunneled/remote devices (~80x on axon)
+            self._macc_.copy_to_host_async()
+        except AttributeError:
+            pass
         value = float(self._macc_)
         self._macc_ = jnp.zeros((), self._macc_dtype)
         if self.loss_kind == "softmax":

@@ -55,18 +55,20 @@ class MnistLoader(FullBatchLoader):
 def create_workflow(fused=True, **overrides):
     cfg = root.mnist
     decision = cfg.decision.todict()
-    decision.update(overrides.get("decision", {}))
+    decision.update(overrides.pop("decision", {}))
     loader = cfg.loader.todict()
-    loader.update(overrides.get("loader", {}))
+    loader.update(overrides.pop("loader", {}))
+    layers = overrides.pop("layers", cfg.layers)
     return StandardWorkflow(
         None,
         name="MnistSimple",
         loader_factory=MnistLoader,
         loader=loader,
-        layers=overrides.get("layers", cfg.layers),
+        layers=layers,
         loss_function="softmax",
         decision=decision,
         fused=fused,
+        **overrides,  # epoch_scan, mesh, model_axis, ...
     )
 
 

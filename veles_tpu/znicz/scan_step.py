@@ -1,0 +1,173 @@
+"""ScanEpochStep: one XLA dispatch per dataset class via ``lax.scan``.
+
+The fused per-minibatch step (fused.py) still pays one host→device dispatch
+per minibatch — on a tunneled/remote TPU that RTT (~1 ms) dominates small
+models.  This unit collapses an ENTIRE class (all train minibatches, or all
+validation minibatches) into one jitted ``lax.scan``:
+
+    (params, opt, macc) = scan(body, init, (idx_matrix, sizes))
+
+with the resident FullBatch dataset gathered per-iteration *inside* the
+scan (``jnp.take``), masks built from the per-batch ``sizes`` vector, so
+results are bit-identical to the per-step path (asserted in tests).  Host
+work per class: build the index matrix (numpy), one device_put, one
+dispatch, one metric flush.
+
+The unit replaces loader+fused_step in the control graph (repeater →
+scan_step → decision); the Loader still owns the dataset, shuffling, and
+epoch counters — this unit drives its flags so Decision units observe the
+exact same protocol (SURVEY.md §7: partition units into traced and host).
+"""
+
+import numpy
+
+from ..units import Unit
+from .. import loader as loader_mod
+from .fused import FusedTrainStep
+
+
+class ScanEpochStep(FusedTrainStep):
+    """FusedTrainStep that consumes one whole class per ``run()``."""
+
+    def __init__(self, workflow, forwards, gd_units, loss="softmax",
+                 **kwargs):
+        super().__init__(workflow, forwards, gd_units, loss=loss, **kwargs)
+        self.loader = None          # set by link_scan_loader
+        self._class_cursor = 0
+        self._epochs_done = 0
+
+    def link_scan_loader(self, loader):
+        self.loader = loader
+        # keep the attribute links Decision peeks at coherent
+        self.link_loader(loader)
+        return self
+
+    def initialize(self, device=None, **kwargs):
+        if not self.loader.is_initialized:
+            # normally the dependency walk has initialized the loader
+            # already (it precedes this unit in the graph); this covers
+            # hand-built workflows
+            self.loader.initialize(device=device, **kwargs)
+        super().initialize(device=device, **kwargs)
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        train = self._train_step_.__wrapped__
+        evaluate = self._eval_step_.__wrapped__
+        data_dev = self.loader.original_data.devmem
+        if self.loss_kind == "softmax":
+            y_dev = jax.device_put(self.loader._dense_labels)
+        else:
+            y_dev = self.loader.original_targets.devmem
+
+        def train_scan(params, opt, macc, idx, sizes):
+            def body(carry, batch):
+                p, o, m = carry
+                bidx, bsize = batch
+                x = jnp.take(data_dev, bidx, axis=0)
+                y = jnp.take(y_dev, bidx, axis=0)
+                p, o, m, loss, _ = train(p, o, m, x, y, bsize)
+                return (p, o, m), loss
+            (params, opt, macc), losses = lax.scan(
+                body, (params, opt, macc), (idx, sizes))
+            return params, opt, macc, losses
+
+        def eval_scan(params, macc, idx, sizes):
+            def body(m, batch):
+                bidx, bsize = batch
+                x = jnp.take(data_dev, bidx, axis=0)
+                y = jnp.take(y_dev, bidx, axis=0)
+                m, loss, _ = evaluate(params, m, x, y, bsize)
+                return m, loss
+            macc, losses = lax.scan(body, macc, (idx, sizes))
+            return macc, losses
+
+        self._train_scan_ = jax.jit(train_scan, donate_argnums=(0, 1, 2))
+        self._eval_scan_ = jax.jit(eval_scan, donate_argnums=(1,))
+
+    # -- epoch driving -------------------------------------------------------
+    def _classes_with_samples(self):
+        return [c for c in (loader_mod.TEST, loader_mod.VALID,
+                            loader_mod.TRAIN)
+                if self.loader.class_lengths[c] > 0]
+
+    def _class_index_matrix(self, cls):
+        """(idx_matrix[nb, B], sizes[nb]) over the class's shuffled span."""
+        ld = self.loader
+        start = 0 if cls == loader_mod.TEST else ld.class_end_offsets[
+            cls - 1]
+        end = ld._class_end(cls)
+        span = numpy.asarray(ld.shuffled_indices.map_read()[start:end])
+        B = ld.max_minibatch_size
+        nb = (len(span) + B - 1) // B
+        idx = numpy.empty((nb, B), ld.INDEX_DTYPE)
+        sizes = numpy.empty(nb, numpy.int32)
+        for i in range(nb):
+            chunk = span[i * B:(i + 1) * B]
+            sizes[i] = len(chunk)
+            idx[i, :len(chunk)] = chunk
+            if len(chunk) < B:
+                idx[i, len(chunk):] = chunk[0]  # pad; masked by sizes
+        return idx, sizes
+
+    def run(self):
+        ld = self.loader
+        classes = self._classes_with_samples()
+        if self._class_cursor == 0 and self._epochs_done:
+            # same moment the per-step loader wraps: entering a new epoch
+            ld.epoch_number += 1
+            ld.shuffle()
+        cls = classes[self._class_cursor]
+        idx, sizes = self._class_index_matrix(cls)
+        if cls == loader_mod.TRAIN:
+            (self._params_, self._opt_, self._macc_, losses) = \
+                self._train_scan_(self._params_, self._opt_, self._macc_,
+                                  idx, sizes)
+        else:
+            self._macc_, losses = self._eval_scan_(
+                self._params_, self._macc_, idx, sizes)
+        self.loss = losses[-1]
+        ld.samples_served += int(sizes.sum())
+        # drive the loader protocol so Decision sees normal class ends
+        ld.minibatch_class = cls
+        ld.minibatch_size = int(sizes[-1])
+        last = self._class_cursor == len(classes) - 1
+        self._class_cursor = 0 if last else self._class_cursor + 1
+        ld.last_minibatch <<= True
+        ld.train_ended <<= cls == loader_mod.TRAIN
+        ld.epoch_ended <<= last
+        if last:
+            self._epochs_done += 1
+        self._flush_metrics()
+        self.sync_weights()
+
+    # -- bulk training -------------------------------------------------------
+    def train_epochs(self, n_epochs):
+        """Train ``n_epochs`` full TRAIN classes in ONE dispatch.
+
+        Per-epoch shuffles are precomputed host-side and concatenated into
+        one (n_epochs * nb, B) index tensor, so fixed-epoch bulk training
+        (no per-epoch early stopping — the user trades Decision granularity
+        for wall-clock) pays a single dispatch + a single metric read.
+        On tunneled devices where any fresh device read costs ~90 ms this
+        is the difference between 60k and >1M images/sec."""
+        ld = self.loader
+        chunks = []
+        for _ in range(n_epochs):
+            if self._epochs_done:
+                ld.epoch_number += 1
+                ld.shuffle()
+            idx, sizes = self._class_index_matrix(loader_mod.TRAIN)
+            chunks.append((idx, sizes))
+            self._epochs_done += 1
+        idx = numpy.concatenate([c[0] for c in chunks])
+        sizes = numpy.concatenate([c[1] for c in chunks])
+        (self._params_, self._opt_, self._macc_, losses) = \
+            self._train_scan_(self._params_, self._opt_, self._macc_,
+                              idx, sizes)
+        self.loss = losses[-1]
+        ld.samples_served += int(sizes.sum())
+        ld.minibatch_class = loader_mod.TRAIN
+        self._flush_metrics()
+        self.sync_weights()

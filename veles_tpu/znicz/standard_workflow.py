@@ -60,6 +60,9 @@ class StandardWorkflow(Workflow):
         self.fused = kwargs.get("fused", True)
         self.mesh = kwargs.get("mesh")           # jax.sharding.Mesh → SPMD
         self.model_axis = kwargs.get("model_axis")
+        # epoch_scan: one lax.scan dispatch per class instead of one
+        # dispatch per minibatch (FullBatch loaders only)
+        self.epoch_scan = kwargs.get("epoch_scan", False)
         self.decision_config = dict(kwargs.get("decision", {}))
         self.loader_config = dict(kwargs.get("loader", {}))
         loader_factory = kwargs.get("loader_factory")
@@ -141,16 +144,33 @@ class StandardWorkflow(Workflow):
         # through them
         for fwd in self.forwards:
             fwd.unlink_all()
+        if self.mesh is not None and self.epoch_scan:
+            raise ValueError(
+                "epoch_scan over a mesh is not implemented yet; pass one "
+                "of mesh= or epoch_scan=")
         if self.mesh is not None:
             from ..parallel.dp import DistributedTrainStep
             self.fused_step = DistributedTrainStep(
                 self, self.forwards, self.gds, mesh=self.mesh,
                 loss=self.loss_function, model_axis=self.model_axis)
+            self.fused_step.link_from(self.loader)
+            self.fused_step.link_loader(self.loader)
+        elif self.epoch_scan:
+            from ..mutable import Bool
+            from .scan_step import ScanEpochStep
+            self.fused_step = ScanEpochStep(
+                self, self.forwards, self.gds, loss=self.loss_function)
+            # the scan step drives the loader itself; the loader stays
+            # linked (so it initializes before the scan step in dependency
+            # order) but permanently blocked from running
+            self.loader.gate_block = Bool(True)
+            self.fused_step.link_from(self.repeater)
+            self.fused_step.link_scan_loader(self.loader)
         else:
             self.fused_step = FusedTrainStep(
                 self, self.forwards, self.gds, loss=self.loss_function)
-        self.fused_step.link_from(self.loader)
-        self.fused_step.link_loader(self.loader)
+            self.fused_step.link_from(self.loader)
+            self.fused_step.link_loader(self.loader)
         self.decision.link_from(self.fused_step)
         self.decision.link_loader(self.loader)
         self.decision.link_evaluator(self.fused_step)
