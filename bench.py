@@ -21,7 +21,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_VALUE = 1_450_000.0
 
 
-def bench_mnist(batch=512, epochs=24, warmup=4, n_train=16384):
+def bench_mnist(batch=512, epochs=24, n_train=16384):
     """Bulk epoch-scan training throughput (one dispatch per epoch block)."""
     from veles_tpu.backends import Device
     from veles_tpu.prng import RandomGenerator

@@ -66,7 +66,7 @@ class DistributedTrainStep(FusedTrainStep):
         self._train_step_ = jax.jit(
             raw_train,
             in_shardings=(param_shard, opt_shard, scalar, batch_shard,
-                          label_shard),
+                          label_shard, scalar),
             out_shardings=(param_shard, opt_shard, scalar, scalar,
                            batch_shard),
             static_argnums=(5,),

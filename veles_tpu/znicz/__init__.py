@@ -20,3 +20,20 @@ from .gd import (GradientDescent, GDTanh, GDSigmoid, GDRELU,
 from .evaluator import EvaluatorSoftmax, EvaluatorMSE    # noqa: F401
 from .decision import (DecisionGD, DecisionMSE,
                        TrivialDecision)                  # noqa: F401
+from .conv import (Conv, ConvTanh, ConvSigmoid, ConvRELU,
+                   ConvStrictRELU)                       # noqa: F401
+from .gd_conv import (GradientDescentConv, GDTanhConv, GDSigmoidConv,
+                      GDRELUConv, GDStrictRELUConv)      # noqa: F401
+from .pooling import (MaxPooling, AvgPooling, MaxAbsPooling,
+                      StochasticPooling, StochasticAbsPooling,
+                      StochasticPoolingDepooling,
+                      StochasticAbsPoolingDepooling)     # noqa: F401
+from .gd_pooling import (GDMaxPooling, GDAvgPooling,
+                         GDMaxAbsPooling)                # noqa: F401
+from .dropout import DropoutForward, DropoutBackward     # noqa: F401
+from .lrn import (LRNormalizerForward,
+                  LRNormalizerBackward)                  # noqa: F401
+from . import activation                                 # noqa: F401
+from .misc_units import (Cutter, GDCutter, ChannelSplitter,
+                         ChannelMerger, ZeroFiller, Deconv, GDDeconv,
+                         Depooling)                      # noqa: F401

@@ -1,0 +1,146 @@
+"""Standalone activation units.
+
+Re-creation of ``veles.znicz.activation`` (absent; SURVEY.md §2.9):
+Forward{Tanh,Sigmoid,RELU,StrictRELU,Log,TanhLog,SinCos,Mul} with matching
+Backward units.  These exist for graphs that interleave activations between
+non-activation layers (e.g. conv → norm → activation).
+"""
+
+import numpy
+
+from .nn_units import (ForwardBase, ParamlessForward,  # noqa: F401
+                       GradientDescentBase)
+from . import activations
+
+
+class ActivationForward(ParamlessForward):
+    hide_from_registry = True
+    ACTIVATION = None
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.activation = activations.get(self.ACTIVATION)
+        self.include_bias = False
+
+    def apply(self, params, x):
+        return self.activation.fwd_jnp(x)
+
+    def apply_numpy(self, params, x):
+        return self.activation.fwd_np(x)
+
+
+class ActivationBackward(GradientDescentBase):
+    hide_from_registry = True
+    ACTIVATION = None
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("learning_rate", 0.0)
+        super().__init__(workflow, **kwargs)
+        self.activation = activations.get(self.ACTIVATION)
+
+    def backward(self, params, x, y, err_output, n_valid=None):
+        return err_output * self.activation.deriv_jnp(y, x), {}
+
+    def backward_numpy(self, params, x, y, err_output, n_valid=None):
+        return err_output * self.activation.deriv_np(y, x), {}
+
+
+class ForwardTanh(ActivationForward):
+    MAPPING = "activation_tanh"
+    ACTIVATION = "tanh"
+
+
+class BackwardTanh(ActivationBackward):
+    MAPPING = "activation_tanh"
+    ACTIVATION = "tanh"
+
+
+class ForwardSigmoid(ActivationForward):
+    MAPPING = "activation_sigmoid"
+    ACTIVATION = "sigmoid"
+
+
+class BackwardSigmoid(ActivationBackward):
+    MAPPING = "activation_sigmoid"
+    ACTIVATION = "sigmoid"
+
+
+class ForwardRELU(ActivationForward):
+    MAPPING = "activation_relu"
+    ACTIVATION = "relu"
+
+
+class BackwardRELU(ActivationBackward):
+    MAPPING = "activation_relu"
+    ACTIVATION = "relu"
+
+
+class ForwardStrictRELU(ActivationForward):
+    MAPPING = "activation_str"
+    ACTIVATION = "strict_relu"
+
+
+class BackwardStrictRELU(ActivationBackward):
+    MAPPING = "activation_str"
+    ACTIVATION = "strict_relu"
+
+
+class ForwardLog(ActivationForward):
+    MAPPING = "activation_log"
+    ACTIVATION = "log"
+
+
+class BackwardLog(ActivationBackward):
+    MAPPING = "activation_log"
+    ACTIVATION = "log"
+
+
+class ForwardTanhLog(ActivationForward):
+    MAPPING = "activation_tanhlog"
+    ACTIVATION = "tanhlog"
+
+
+class BackwardTanhLog(ActivationBackward):
+    MAPPING = "activation_tanhlog"
+    ACTIVATION = "tanhlog"
+
+
+class ForwardSinCos(ActivationForward):
+    MAPPING = "activation_sincos"
+    ACTIVATION = "sincos"
+
+
+class BackwardSinCos(ActivationBackward):
+    MAPPING = "activation_sincos"
+    ACTIVATION = "sincos"
+
+
+class ForwardMul(ParamlessForward):
+    """y = x * factor (Znicz ForwardMul)."""
+
+    MAPPING = "activation_mul"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.factor = float(kwargs.get("factor", 1.0))
+        self.include_bias = False
+
+    def apply(self, params, x):
+        return x * self.factor
+
+    def apply_numpy(self, params, x):
+        return x * self.factor
+
+
+class BackwardMul(GradientDescentBase):
+    MAPPING = "activation_mul"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("learning_rate", 0.0)
+        super().__init__(workflow, **kwargs)
+        self.factor = float(kwargs.get("factor", 1.0))
+
+    def backward(self, params, x, y, err_output, n_valid=None):
+        return err_output * self.factor, {}
+
+    backward_numpy = backward

@@ -1,0 +1,128 @@
+"""Convolutional forward units.
+
+Re-creation of ``veles.znicz.conv`` (absent; inventory SURVEY.md §2.9;
+parameters n_kernels/kx/ky/padding/sliding per
+/root/reference/docs/source/manualrst_veles_workflow_parameters.rst:421-436).
+
+TPU-first: NHWC activations, HWIO weights, one
+``lax.conv_general_dilated`` — the exact op XLA tiles onto the MXU; the
+activation fuses into its epilogue.  The numpy twin is an independent
+im2col implementation (the same construction the reference's GPU kernels
+use) so the parity tests cross-check two different algorithms.
+"""
+
+import numpy
+
+from .nn_units import ForwardBase
+from . import activations
+
+
+def _quad(padding):
+    """Normalize padding to (top, bottom, left, right)."""
+    if isinstance(padding, int):
+        return (padding,) * 4
+    if len(padding) == 2:
+        py, px = padding
+        return (py, py, px, px)
+    return tuple(padding)
+
+
+class Conv(ForwardBase):
+    """2-D convolution + activation.  Input NHWC; weights (kx, ky, C, K)."""
+
+    MAPPING = "conv"
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.n_kernels = kwargs["n_kernels"]
+        self.kx = kwargs["kx"]
+        self.ky = kwargs["ky"]
+        self.padding = _quad(kwargs.get("padding", 0))
+        self.sliding = tuple(kwargs.get("sliding", (1, 1)))
+        # grouped convolution (AlexNet's two-tower split): native
+        # feature_group_count — faster than the reference's ZeroFiller
+        # weight-masking trick, same math
+        self.grouping = int(kwargs.get("grouping", 1))
+        self.activation = activations.get(self.ACTIVATION)
+
+    def init_params(self):
+        c_in = self.input_shape[-1]
+        n_in = self.kx * self.ky * c_in // self.grouping
+        stddev = self.weights_stddev or 1.0 / numpy.sqrt(n_in)
+        self.fill_array(self.weights,
+                        (self.ky, self.kx, c_in // self.grouping,
+                         self.n_kernels),
+                        stddev, self.weights_filling)
+        if self.include_bias:
+            self.fill_array(self.bias, (self.n_kernels,),
+                            self.bias_stddev or stddev, self.bias_filling)
+
+    def output_shape_for(self, input_shape):
+        b, h, w, _ = input_shape
+        pt, pb, pl, pr = self.padding
+        oh = (h + pt + pb - self.ky) // self.sliding[0] + 1
+        ow = (w + pl + pr - self.kx) // self.sliding[1] + 1
+        return (b, oh, ow, self.n_kernels)
+
+    def apply(self, params, x):
+        import jax.numpy as jnp
+        from jax import lax
+        pt, pb, pl, pr = self.padding
+        y = lax.conv_general_dilated(
+            x, params["weights"],
+            window_strides=self.sliding,
+            padding=((pt, pb), (pl, pr)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.grouping)
+        if "bias" in params:
+            y = y + params["bias"]
+        return self.activation.fwd_jnp(y)
+
+    def apply_numpy(self, params, x):
+        """Independent im2col twin (per-group)."""
+        w = params["weights"]
+        ky, kx, c_g, n_k = w.shape
+        g = self.grouping
+        pt, pb, pl, pr = self.padding
+        sy, sx = self.sliding
+        xp = numpy.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+        b, h, w_, c_in = xp.shape
+        oh = (h - ky) // sy + 1
+        ow = (w_ - kx) // sx + 1
+        y = numpy.empty((b, oh, ow, n_k), x.dtype)
+        kpg = n_k // g
+        for gi in range(g):
+            xg = xp[..., gi * c_g:(gi + 1) * c_g]
+            wg = w[..., gi * kpg:(gi + 1) * kpg]
+            cols = numpy.empty((b, oh, ow, ky * kx * c_g), x.dtype)
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xg[:, i * sy:i * sy + ky,
+                               j * sx:j * sx + kx, :]
+                    cols[:, i, j, :] = patch.reshape(b, -1)
+            y[..., gi * kpg:(gi + 1) * kpg] = cols @ wg.reshape(-1, kpg)
+        if "bias" in params:
+            y = y + params["bias"]
+        return self.activation.fwd_np(y)
+
+
+class ConvTanh(Conv):
+    MAPPING = "conv_tanh"
+    ACTIVATION = "tanh"
+
+
+class ConvSigmoid(Conv):
+    MAPPING = "conv_sigmoid"
+    ACTIVATION = "sigmoid"
+
+
+class ConvRELU(Conv):
+    """Znicz "RELU" = softplus."""
+    MAPPING = "conv_relu"
+    ACTIVATION = "relu"
+
+
+class ConvStrictRELU(Conv):
+    MAPPING = "conv_str"
+    ACTIVATION = "strict_relu"

@@ -1,0 +1,71 @@
+"""Dropout forward/backward units.
+
+Re-creation of ``veles.znicz.dropout`` (absent; SURVEY.md §2.9).  Inverted
+dropout: train-time ``x * bernoulli(1-p) / (1-p)``, eval-time identity.
+
+Keys arrive as arguments (jit-safe, reproducible).  In graph mode the
+forward records the key it drew for the minibatch and the backward
+*regenerates* the same Bernoulli mask from it — exact, with no mask buffer
+(the reference stores a mask array; regenerating from the counter-derived
+key is free on TPU and keeps the unit stateless).
+"""
+
+from ..prng.random_generator import KeyTree
+from .nn_units import ParamlessForward, GradientDescentBase
+
+
+class DropoutForward(ParamlessForward):
+    MAPPING = "dropout"
+    stochastic = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.dropout_ratio = float(kwargs.get("dropout_ratio", 0.5))
+        self.include_bias = False
+        self.key_tree = kwargs.get("key_tree") or KeyTree(
+            kwargs.get("seed", 42))
+
+    def apply(self, params, x):
+        return x
+
+    def apply_train(self, params, x, key):
+        import jax
+        keep = 1.0 - self.dropout_ratio
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return x * mask / keep
+
+    def apply_numpy(self, params, x):
+        return x
+
+
+class DropoutBackward(GradientDescentBase):
+    """Regenerates the forward's mask from its recorded key and routes the
+    error through it.  Not jitted: the key changes every minibatch, so the
+    two elementwise ops run eagerly (XLA fuses them anyway)."""
+
+    MAPPING = "dropout"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("learning_rate", 0.0)
+        super().__init__(workflow, **kwargs)
+
+    def tpu_init(self):
+        self._jitted_bwd_ = self._bwd_eager
+
+    def _bwd_eager(self, params, x, y, err_output, n_valid=None):
+        return self.backward(params, x, y, err_output, n_valid)
+
+    def backward(self, params, x, y, err_output, n_valid=None):
+        fwd = self.forward_unit
+        key = fwd.last_key
+        if key is None:
+            return err_output, {}
+        import jax
+        keep = 1.0 - fwd.dropout_ratio
+        mask = jax.random.bernoulli(key, keep, err_output.shape)
+        return err_output * mask / keep, {}
+
+    def backward_numpy(self, params, x, y, err_output, n_valid=None):
+        import numpy
+        err_in, grads = self.backward(params, x, y, err_output, n_valid)
+        return numpy.asarray(err_in), grads

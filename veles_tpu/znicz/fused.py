@@ -58,6 +58,11 @@ class FusedTrainStep(Unit, IResultProvider):
         self.loss = None
         self.output = Array()      # last forward's output (for consumers)
         self.max_idx = Array()
+        # deterministic per-step seed for stochastic units (dropout,
+        # stochastic pooling); pickles with the snapshot.  Kept within
+        # int32 so it passes as a jit scalar without overflow.
+        self._seed_counter = (int(kwargs.get("seed", 42)) *
+                              1_000_003) % 0x7FFF0000
 
     def link_loader(self, loader):
         self.link_attrs(loader, "minibatch_data", "minibatch_labels",
@@ -84,18 +89,26 @@ class FusedTrainStep(Unit, IResultProvider):
         gds = self.gd_units
         loss_kind = self.loss_kind
         softmax_head = isinstance(forwards[-1], All2AllSoftmax)
+        has_stochastic = any(f.stochastic for f in forwards)
 
-        def net_apply(params, x, with_logits):
+        def net_apply(params, x, with_logits, seed):
             h = x
+            train = seed is not None
+            if train and has_stochastic:
+                key = jax.random.PRNGKey(seed)
             for i, fwd in enumerate(forwards[:-1]):
-                h = fwd.apply(params[i], h)
+                if train and fwd.stochastic:
+                    h = fwd.apply_train(params[i], h,
+                                        jax.random.fold_in(key, i))
+                else:
+                    h = fwd.apply(params[i], h)
             last = forwards[-1]
             if with_logits and softmax_head:
                 return last.apply_logits(params[-1], h)
             return last.apply(params[-1], h)
 
-        def loss_fn(params, x, labels_or_targets, mask):
-            out = net_apply(params, x, True)
+        def loss_fn(params, x, labels_or_targets, mask, seed=None):
+            out = net_apply(params, x, True, seed)
             if loss_kind == "softmax":
                 data_loss = EvaluatorSoftmax.loss_from_logits(
                     out, labels_or_targets, mask)
@@ -113,10 +126,10 @@ class FusedTrainStep(Unit, IResultProvider):
             err = (out - labels_or_targets).reshape(out.shape[0], -1)
             return ((err * err).mean(axis=1) * mask).sum()
 
-        def train_step(params, opt, macc, x, y, size):
+        def train_step(params, opt, macc, x, y, size, seed):
             mask = (jnp.arange(x.shape[0]) < size).astype(jnp.float32)
             (loss, out), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, x, y, mask)
+                loss_fn, has_aux=True)(params, x, y, mask, seed)
             new_params, new_opt = [], []
             for i, gd in enumerate(gds):
                 layer_p, layer_o = {}, {}
@@ -168,9 +181,10 @@ class FusedTrainStep(Unit, IResultProvider):
             y = self.minibatch_targets.devmem
         size = int(self.minibatch_size)
         if self.minibatch_class == loader_mod.TRAIN:
+            self._seed_counter = (self._seed_counter + 1) % 0x7FFF0000
             (self._params_, self._opt_, self._macc_, loss, out) = \
                 self._train_step_(self._params_, self._opt_, self._macc_,
-                                  x, y, size)
+                                  x, y, size, self._seed_counter)
         else:
             self._macc_, loss, out = self._eval_step_(
                 self._params_, self._macc_, x, y, size)

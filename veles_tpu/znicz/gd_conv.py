@@ -1,0 +1,43 @@
+"""Gradient-descent units for the conv family.
+
+Re-creation of ``veles.znicz.gd_conv`` (absent; SURVEY.md §2.9):
+GradientDescentConv + activation variants.  Backward runs through
+``jax.vjp`` of the forward (XLA emits the transpose conv for err_input and
+the correlation for grad_W — the two kernels the reference hand-writes),
+sharing solver machinery with the all2all GD units.
+"""
+
+from .nn_units import GradientDescentBase
+
+
+class GradientDescentConv(GradientDescentBase):
+    MAPPING = "conv"
+
+    def backward(self, params, x, y, err_output, n_valid=None):
+        if n_valid is None:
+            n_valid = x.shape[0]
+        return self.backward_via_vjp(params, x, err_output, n_valid)
+
+    def backward_numpy(self, params, x, y, err_output, n_valid=None):
+        import numpy
+        if n_valid is None:
+            n_valid = x.shape[0]
+        err_in, grads = self.backward(params, x, y, err_output, n_valid)
+        return (numpy.asarray(err_in) if err_in is not None else None,
+                {k: numpy.asarray(v) for k, v in grads.items()})
+
+
+class GDTanhConv(GradientDescentConv):
+    MAPPING = "conv_tanh"
+
+
+class GDSigmoidConv(GradientDescentConv):
+    MAPPING = "conv_sigmoid"
+
+
+class GDRELUConv(GradientDescentConv):
+    MAPPING = "conv_relu"
+
+
+class GDStrictRELUConv(GradientDescentConv):
+    MAPPING = "conv_str"

@@ -1,0 +1,58 @@
+"""Gradient units for pooling layers.
+
+Re-creation of ``veles.znicz.gd_pooling`` (absent; SURVEY.md §2.9):
+GDMaxPooling (route error to the argmax element), GDAvgPooling (spread
+error uniformly), GDMaxAbsPooling.  All are parameterless; the error
+routing is the vjp of the forward — XLA emits the select-and-scatter
+kernel the reference hand-writes.
+"""
+
+from .nn_units import GenericVJPBackward
+
+
+class GDPoolingBase(GenericVJPBackward):
+    hide_from_registry = True
+
+
+class GDMaxPooling(GDPoolingBase):
+    MAPPING = "max_pooling"
+
+
+class GDAvgPooling(GDPoolingBase):
+    MAPPING = "avg_pooling"
+
+
+class GDMaxAbsPooling(GDPoolingBase):
+    MAPPING = "maxabs_pooling"
+
+
+class GDStochasticPooling(GDPoolingBase):
+    """Graph-mode backward through the SAME stochastic draw the forward
+    made (regenerated from its recorded key); eval minibatches route
+    through the expected-value forward."""
+
+    MAPPING = "stochastic_pooling"
+
+    def tpu_init(self):
+        self._jitted_bwd_ = self.backward  # key varies per minibatch
+
+    def backward(self, params, x, y, err_output, n_valid=None):
+        import jax
+        fwd = self.forward_unit
+        key = fwd.last_key
+        if key is None:
+            fn = lambda xx: fwd.apply({}, xx)          # noqa: E731
+        else:
+            fn = lambda xx: fwd.apply_train({}, xx, key)  # noqa: E731
+        _, pullback = jax.vjp(fn, x)
+        (err_in,) = pullback(err_output)
+        return err_in, {}
+
+    def backward_numpy(self, params, x, y, err_output, n_valid=None):
+        import numpy
+        err_in, grads = self.backward(params, x, y, err_output, n_valid)
+        return numpy.asarray(err_in), grads
+
+
+class GDStochasticAbsPooling(GDStochasticPooling):
+    MAPPING = "stochastic_abs_pooling"

@@ -1,0 +1,46 @@
+"""Local response normalization (AlexNet LRN).
+
+Re-creation of ``veles.znicz.normalization.LRNormalizerForward/Backward``
+(absent; SURVEY.md §2.9).  Cross-channel LRN:
+
+    y = x / (k + alpha/n * sum_{j in window} x_j^2) ** beta
+
+computed with a channel-axis ``reduce_window`` — fuses cleanly in XLA.
+"""
+
+import numpy
+
+from .nn_units import ParamlessForward, GenericVJPBackward
+
+
+class LRNormalizerForward(ParamlessForward):
+    MAPPING = "norm"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.alpha = float(kwargs.get("alpha", 1e-4))
+        self.beta = float(kwargs.get("beta", 0.75))
+        self.k = float(kwargs.get("k", 2.0))
+        self.n = int(kwargs.get("n", 5))
+        self.include_bias = False
+
+    def _den(self, sq, xp):
+        half = self.n // 2
+        pad = [(0, 0)] * sq.ndim
+        pad[-1] = (half, half)
+        padded = xp.pad(sq, pad)
+        acc = xp.zeros_like(sq)
+        for d in range(self.n):
+            acc = acc + padded[..., d:d + sq.shape[-1]]
+        return (self.k + (self.alpha / self.n) * acc) ** self.beta
+
+    def apply(self, params, x):
+        import jax.numpy as jnp
+        return x / self._den(x * x, jnp)
+
+    def apply_numpy(self, params, x):
+        return x / self._den(x * x, numpy)
+
+
+class LRNormalizerBackward(GenericVJPBackward):
+    MAPPING = "norm"

@@ -1,0 +1,240 @@
+"""Structural layer units: Cutter, ChannelSplitter/Merger, ZeroFiller,
+Deconv.
+
+Re-creation of the remaining Znicz layer inventory (absent submodule;
+SURVEY.md §2.9): ``cutter.Cutter/GDCutter``,
+``channel_splitting.ChannelSplitter/Merger``,
+``weights_zerofilling.ZeroFiller``, ``deconv.Deconv/gd_deconv.GDDeconv``,
+``depooling.Depooling``.
+"""
+
+import numpy
+
+from .nn_units import (ForwardBase, GradientDescentBase,
+                       ParamlessForward as _ParamlessForward)
+from .conv import _quad
+
+
+class Cutter(_ParamlessForward):
+    """Crops a spatial region: y = x[:, top:top+h, left:left+w, :]."""
+
+    MAPPING = "cutter"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.top = kwargs.get("top", 0)
+        self.left = kwargs.get("left", 0)
+        self.crop_h = kwargs["crop_h"]
+        self.crop_w = kwargs["crop_w"]
+        self.include_bias = False
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0], self.crop_h, self.crop_w, input_shape[3])
+
+    def apply(self, params, x):
+        return x[:, self.top:self.top + self.crop_h,
+                 self.left:self.left + self.crop_w, :]
+
+    apply_numpy = apply
+
+
+class GDCutter(GradientDescentBase):
+    """Backward: pad the error back into the uncropped shape."""
+
+    MAPPING = "cutter"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("learning_rate", 0.0)
+        super().__init__(workflow, **kwargs)
+
+    def backward(self, params, x, y, err_output, n_valid=None):
+        import jax.numpy as jnp
+        cut = self.forward_unit
+        pads = ((0, 0),
+                (cut.top, x.shape[1] - cut.top - cut.crop_h),
+                (cut.left, x.shape[2] - cut.left - cut.crop_w),
+                (0, 0))
+        return jnp.pad(err_output, pads), {}
+
+    def backward_numpy(self, params, x, y, err_output, n_valid=None):
+        cut = self.forward_unit
+        pads = ((0, 0),
+                (cut.top, x.shape[1] - cut.top - cut.crop_h),
+                (cut.left, x.shape[2] - cut.left - cut.crop_w),
+                (0, 0))
+        return numpy.pad(err_output, pads), {}
+
+
+class ChannelSplitter(_ParamlessForward):
+    """NHWC → list of per-group tensors stacked on a new axis (the Znicz
+    unit splits interleaved channels for grouped convolutions)."""
+
+    MAPPING = "channel_splitter"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.groups = kwargs.get("groups", 2)
+        self.include_bias = False
+
+    def output_shape_for(self, input_shape):
+        b, h, w, c = input_shape
+        return (self.groups, b, h, w, c // self.groups)
+
+    def apply(self, params, x):
+        b, h, w, c = x.shape
+        g = self.groups
+        return x.reshape(b, h, w, g, c // g).transpose(3, 0, 1, 2, 4)
+
+    apply_numpy = apply
+
+
+class ChannelMerger(_ParamlessForward):
+    """Inverse of ChannelSplitter."""
+
+    MAPPING = "channel_merger"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.include_bias = False
+
+    def output_shape_for(self, input_shape):
+        g, b, h, w, cg = input_shape
+        return (b, h, w, g * cg)
+
+    def apply(self, params, x):
+        g, b, h, w, cg = x.shape
+        return x.transpose(1, 2, 3, 0, 4).reshape(b, h, w, g * cg)
+
+    apply_numpy = apply
+
+
+class ZeroFiller(_ParamlessForward):
+    """Zeroes a fixed mask of weights in an attached forward unit every
+    run (the Znicz grouping trick for AlexNet's split convolutions).
+
+    GRAPH MODE ONLY: in fused mode ``run()`` never fires (forwards live
+    outside the control graph) and the masking would not reach the fused
+    params — use the native ``Conv(grouping=N)`` instead, which is both
+    correct under fusion and faster (XLA grouped conv).  StandardWorkflow
+    raises if a zero_filler layer appears in a fused build."""
+
+    MAPPING = "zero_filler"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.target_unit = kwargs.get("target_unit")
+        self.grouping = kwargs.get("grouping", 2)
+        self.include_bias = False
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def apply(self, params, x):
+        return x
+
+    apply_numpy = apply
+
+    def make_mask(self, weights_shape):
+        """Block-diagonal channel mask: group g of kernels sees only group
+        g of input channels."""
+        ky, kx, c_in, n_k = weights_shape
+        g = self.grouping
+        mask = numpy.zeros(weights_shape, numpy.float32)
+        for i in range(g):
+            mask[:, :, i * (c_in // g):(i + 1) * (c_in // g),
+                 i * (n_k // g):(i + 1) * (n_k // g)] = 1
+        return mask
+
+    def run(self):
+        if self.target_unit is not None and self.target_unit.weights:
+            w = self.target_unit.weights.map_write()
+            w *= self.make_mask(w.shape)
+
+
+class Deconv(ForwardBase):
+    """Transposed convolution (conv autoencoder decoder; reference
+    deconv.Deconv)."""
+
+    MAPPING = "deconv"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.n_kernels = kwargs["n_kernels"]    # output channels
+        self.kx = kwargs["kx"]
+        self.ky = kwargs["ky"]
+        self.padding = _quad(kwargs.get("padding", 0))
+        self.sliding = tuple(kwargs.get("sliding", (1, 1)))
+        self.include_bias = bool(kwargs.get("include_bias", False))
+
+    def init_params(self):
+        c_in = self.input_shape[-1]
+        n_in = self.kx * self.ky * c_in
+        stddev = self.weights_stddev or 1.0 / numpy.sqrt(n_in)
+        self.fill_array(self.weights,
+                        (self.ky, self.kx, c_in, self.n_kernels),
+                        stddev, self.weights_filling)
+        if self.include_bias:
+            self.fill_array(self.bias, (self.n_kernels,),
+                            self.bias_stddev or stddev, self.bias_filling)
+
+    def output_shape_for(self, input_shape):
+        b, h, w, _ = input_shape
+        pt, pb, pl, pr = self.padding
+        oh = (h - 1) * self.sliding[0] + self.ky - pt - pb
+        ow = (w - 1) * self.sliding[1] + self.kx - pl - pr
+        return (b, oh, ow, self.n_kernels)
+
+    def apply(self, params, x):
+        from jax import lax
+        pt, pb, pl, pr = self.padding
+        y = lax.conv_transpose(
+            x, params["weights"],
+            strides=self.sliding,
+            padding=((pt, pb), (pl, pr)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if "bias" in params:
+            y = y + params["bias"]
+        return y
+
+    def apply_numpy(self, params, x):
+        return numpy.asarray(self.apply(
+            {k: numpy.asarray(v) for k, v in params.items()}, x))
+
+
+class GDDeconv(GradientDescentBase):
+    MAPPING = "deconv"
+
+    def backward(self, params, x, y, err_output, n_valid=None):
+        if n_valid is None:
+            n_valid = x.shape[0]
+        return self.backward_via_vjp(params, x, err_output, n_valid)
+
+    def backward_numpy(self, params, x, y, err_output, n_valid=None):
+        err_in, grads = self.backward(params, x, y, err_output, n_valid)
+        return (numpy.asarray(err_in) if err_in is not None else None,
+                {k: numpy.asarray(v) for k, v in grads.items()})
+
+
+class Depooling(_ParamlessForward):
+    """Nearest upsampling by the pooling window (reference
+    depooling.Depooling used in conv AEs)."""
+
+    MAPPING = "depooling"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.kx = kwargs.get("kx", 2)
+        self.ky = kwargs.get("ky", 2)
+        self.include_bias = False
+
+    def output_shape_for(self, input_shape):
+        b, h, w, c = input_shape
+        return (b, h * self.ky, w * self.kx, c)
+
+    def apply(self, params, x):
+        import jax.numpy as jnp
+        return jnp.repeat(jnp.repeat(x, self.ky, axis=1), self.kx, axis=2)
+
+    def apply_numpy(self, params, x):
+        return numpy.repeat(numpy.repeat(x, self.ky, axis=1),
+                            self.kx, axis=2)

@@ -41,6 +41,34 @@ class ForwardBase(NNUnitBase):
     hide_from_registry = True
     view_group = "WORKER"
     MAPPING = None  # StandardWorkflow layer-type key
+    #: True for units whose train-time forward draws randomness (dropout,
+    #: stochastic pooling) — they implement apply_train(params, x, key);
+    #: the key ARRIVES AS AN ARGUMENT so jit never freezes the draw
+    stochastic = False
+
+    def apply_train(self, params, x, key=None):
+        """Train-time forward; defaults to the eval forward.  Stochastic
+        units override and consume ``key``."""
+        return self.apply(params, x)
+
+    #: stochastic units hold a KeyTree; graph mode draws one key per train
+    #: minibatch and records it so the matching backward can regenerate
+    #: the same draw (no mask storage needed)
+    key_tree = None
+    minibatch_class = None   # linked from the loader for stochastic units
+
+    def _graph_training(self):
+        from .. import loader as loader_mod
+        return self.stochastic and \
+            self.minibatch_class == loader_mod.TRAIN
+
+    def step_key(self):
+        self._last_key_ = self.key_tree.key_for(self.name)
+        return self._last_key_
+
+    @property
+    def last_key(self):
+        return getattr(self, "_last_key_", None)
 
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)
@@ -118,22 +146,55 @@ class ForwardBase(NNUnitBase):
     def tpu_init(self):
         import jax
         self._jitted_ = jax.jit(self.apply)
+        if self.stochastic:
+            self._jitted_train_ = jax.jit(self.apply_train)
 
     def tpu_run(self):
         x = self.input.devmem if isinstance(self.input, Array) else self.input
-        self.output.devmem = self._jitted_(self.params, x)
+        if self._graph_training():
+            self.output.devmem = self._jitted_train_(
+                self.params, x, self.step_key())
+        else:
+            self.output.devmem = self._jitted_(self.params, x)
 
     def numpy_run(self):
         x = self.input.map_read() if isinstance(self.input, Array) \
             else numpy.asarray(self.input)
-        params = {"weights": self.weights.map_read()}
+        params = {}
+        if self.weights:
+            params["weights"] = self.weights.map_read()
         if self.include_bias and self.bias:
             params["bias"] = self.bias.map_read()
-        self.output.mem = numpy.asarray(self.apply_numpy(params, x))
+        if self._graph_training():
+            # replay the device draw exactly on host (jnp on CPU)
+            self.output.mem = numpy.asarray(
+                self.apply_train(params, x, self.step_key()))
+        else:
+            self.output.mem = numpy.asarray(self.apply_numpy(params, x))
 
     def apply_numpy(self, params, x):
         """Host twin; default falls back to the jnp apply (exact on CPU)."""
         return self.apply(params, x)
+
+
+class ParamlessForward(ForwardBase):
+    """Base for forwards with no trainable parameters (pooling, dropout,
+    activations, structural units)."""
+
+    hide_from_registry = True
+
+    def init_params(self):
+        pass
+
+    @property
+    def params(self):
+        return {}
+
+    def set_params(self, params):
+        pass
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
 
 
 class GradientDescentBase(NNUnitBase):
@@ -224,6 +285,19 @@ class GradientDescentBase(NNUnitBase):
         the mean over the *valid* rows (padded rows carry zero error)."""
         raise NotImplementedError
 
+    def backward_via_vjp(self, params, x, err_output, n_valid):
+        """Generic backward through jax.vjp of the forward's pure apply —
+        the exact chain rule the fused path uses, so graph mode and fused
+        mode agree by construction.  Units with hand-written backward math
+        (the all2all family) override ``backward`` directly; structured ops
+        (conv, pooling, LRN) use this."""
+        import jax
+        fwd = self.forward_unit
+        _, pullback = jax.vjp(lambda p, xx: fwd.apply(p, xx), params, x)
+        grads, err_input = pullback(err_output)
+        grads = jax.tree.map(lambda g: g / n_valid, grads)
+        return err_input, grads
+
     def _n_valid(self, x):
         return int(self.batch_size) if self.batch_size is not None \
             else x.shape[0]
@@ -281,3 +355,25 @@ class GradientDescentBase(NNUnitBase):
         if isinstance(v, Array):
             return v.devmem
         return v
+
+
+class GenericVJPBackward(GradientDescentBase):
+    """Fallback backward for layer types without a registered GD pair
+    (structural units: splitters, depooling, ...): pure vjp pass-through
+    of the forward, no parameters."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("learning_rate", 0.0)
+        super().__init__(workflow, **kwargs)
+
+    def backward(self, params, x, y, err_output, n_valid=None):
+        if n_valid is None:
+            n_valid = x.shape[0]
+        err_in, _ = self.backward_via_vjp({}, x, err_output, n_valid)
+        return err_in, {}
+
+    def backward_numpy(self, params, x, y, err_output, n_valid=None):
+        err_in, grads = self.backward(params, x, y, err_output, n_valid)
+        return numpy.asarray(err_in), grads

@@ -61,16 +61,16 @@ class ScanEpochStep(FusedTrainStep):
         else:
             y_dev = self.loader.original_targets.devmem
 
-        def train_scan(params, opt, macc, idx, sizes):
+        def train_scan(params, opt, macc, idx, sizes, seeds):
             def body(carry, batch):
                 p, o, m = carry
-                bidx, bsize = batch
+                bidx, bsize, bseed = batch
                 x = jnp.take(data_dev, bidx, axis=0)
                 y = jnp.take(y_dev, bidx, axis=0)
-                p, o, m, loss, _ = train(p, o, m, x, y, bsize)
+                p, o, m, loss, _ = train(p, o, m, x, y, bsize, bseed)
                 return (p, o, m), loss
             (params, opt, macc), losses = lax.scan(
-                body, (params, opt, macc), (idx, sizes))
+                body, (params, opt, macc), (idx, sizes, seeds))
             return params, opt, macc, losses
 
         def eval_scan(params, macc, idx, sizes):
@@ -85,6 +85,16 @@ class ScanEpochStep(FusedTrainStep):
 
         self._train_scan_ = jax.jit(train_scan, donate_argnums=(0, 1, 2))
         self._eval_scan_ = jax.jit(eval_scan, donate_argnums=(1,))
+
+    def _next_seeds(self, n):
+        """Deterministic consecutive per-batch seeds (matches the per-step
+        path's counter increments), wrapped to int32 range."""
+        seeds = (numpy.arange(self._seed_counter + 1,
+                              self._seed_counter + 1 + n,
+                              dtype=numpy.int64) % 0x7FFF0000).astype(
+            numpy.int32)
+        self._seed_counter = (self._seed_counter + n) % 0x7FFF0000
+        return seeds
 
     # -- epoch driving -------------------------------------------------------
     def _classes_with_samples(self):
@@ -123,7 +133,7 @@ class ScanEpochStep(FusedTrainStep):
         if cls == loader_mod.TRAIN:
             (self._params_, self._opt_, self._macc_, losses) = \
                 self._train_scan_(self._params_, self._opt_, self._macc_,
-                                  idx, sizes)
+                                  idx, sizes, self._next_seeds(len(sizes)))
         else:
             self._macc_, losses = self._eval_scan_(
                 self._params_, self._macc_, idx, sizes)
@@ -165,7 +175,7 @@ class ScanEpochStep(FusedTrainStep):
         sizes = numpy.concatenate([c[1] for c in chunks])
         (self._params_, self._opt_, self._macc_, losses) = \
             self._train_scan_(self._params_, self._opt_, self._macc_,
-                              idx, sizes)
+                              idx, sizes, self._next_seeds(len(sizes)))
         self.loss = losses[-1]
         ld.samples_served += int(sizes.sum())
         ld.minibatch_class = loader_mod.TRAIN

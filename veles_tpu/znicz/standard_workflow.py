@@ -107,6 +107,10 @@ class StandardWorkflow(Workflow):
                 fwd.link_attrs(self.loader, ("input", "minibatch_data"))
             else:
                 fwd.link_attrs(prev, ("input", "output"))
+            if fwd.stochastic:
+                # stochastic units draw per-train-minibatch keys in graph
+                # mode; they watch the loader's class to know when
+                fwd.link_attrs(self.loader, "minibatch_class")
             self.forwards.append(fwd)
             gd_pairs.append((gd_cls, gd_kwargs))
             prev = fwd
@@ -124,10 +128,14 @@ class StandardWorkflow(Workflow):
 
         # instantiate GD units (shared by both modes: they own solver state
         # and hyperparameters; fused mode reads them, graph mode runs them)
+        from .nn_units import GenericVJPBackward, ParamlessForward
         for (gd_cls, gd_kwargs), fwd in zip(gd_pairs, self.forwards):
             if gd_cls is None:
-                raise ValueError("no GD unit for layer %r" %
-                                 type(fwd).MAPPING)
+                if not isinstance(fwd, ParamlessForward):
+                    raise ValueError(
+                        "no GD unit registered for parameterized layer %r"
+                        % type(fwd).MAPPING)
+                gd_cls = GenericVJPBackward  # paramless structural layer
             gd = gd_cls(self, **gd_kwargs)
             gd.link_forward(fwd)
             self.gds.append(gd)
@@ -148,6 +156,12 @@ class StandardWorkflow(Workflow):
             raise ValueError(
                 "epoch_scan over a mesh is not implemented yet; pass one "
                 "of mesh= or epoch_scan=")
+        from .misc_units import ZeroFiller
+        for fwd in self.forwards:
+            if isinstance(fwd, ZeroFiller):
+                raise ValueError(
+                    "zero_filler is graph-mode only; use Conv(grouping=N) "
+                    "in fused workflows (see ZeroFiller docstring)")
         if self.mesh is not None:
             from ..parallel.dp import DistributedTrainStep
             self.fused_step = DistributedTrainStep(
