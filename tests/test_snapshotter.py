@@ -1,0 +1,93 @@
+"""Snapshot / resume tests (reference test strategy §4.4): a training run
+interrupted by snapshot+restore must produce the same result as an
+uninterrupted run — weights, solver state, RNG streams, and epoch
+accounting all survive the pickle."""
+
+import glob
+import os
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.snapshotter import SnapshotterToFile, restore
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+from test_standard_workflow import BlobLoader, LAYERS
+
+
+def build(max_epochs, tmp_path=None, fused=True, snap=False, seed=31):
+    import veles_tpu.prng.random_generator as rg
+    rg._generators.clear()
+    rg.get(0).seed(seed)
+    kwargs = {}
+    if snap:
+        kwargs["snapshotter"] = {
+            "prefix": "blob", "directory": str(tmp_path),
+            "time_interval": 0, "compression": "gz"}
+    wf = StandardWorkflow(
+        None, name="snapwf",
+        loader_factory=BlobLoader,
+        loader={"minibatch_size": 25, "prng": RandomGenerator().seed(5)},
+        layers=LAYERS, loss_function="softmax",
+        decision={"max_epochs": max_epochs, "silent": True},
+        fused=fused, **kwargs)
+    wf.initialize(device=Device(backend="cpu"))
+    return wf
+
+
+def test_snapshot_resume_equals_uninterrupted(tmp_path):
+    # uninterrupted 6-epoch run
+    ref = build(6)
+    ref.run()
+
+    # interrupted: 3 epochs with snapshots, then restore and continue
+    part = build(3, tmp_path, snap=True)
+    part.run()
+    snaps = glob.glob(str(tmp_path / "blob*.pickle.gz"))
+    assert snaps, "no snapshot written"
+    current = str(tmp_path / "blob_current")
+    assert os.path.islink(current)
+
+    resumed = restore(current)
+    assert resumed.restored_from_snapshot
+    resumed.decision.max_epochs = 6
+    resumed.initialize(device=Device(backend="cpu"))
+    resumed.run()
+
+    assert resumed.loader.epoch_number == ref.loader.epoch_number
+    for fr, fu in zip(resumed.forwards, ref.forwards):
+        assert numpy.allclose(fr.weights.map_read(), fu.weights.map_read(),
+                              atol=1e-5), type(fr).__name__
+    assert resumed.decision.epoch_n_err_pt[1] == \
+        pytest.approx(ref.decision.epoch_n_err_pt[1], abs=1e-9)
+
+
+def test_snapshot_resume_graph_mode(tmp_path):
+    part = build(2, tmp_path, fused=False, snap=True)
+    part.run()
+    current = str(tmp_path / "blob_current")
+    resumed = restore(current)
+    resumed.decision.max_epochs = 4
+    resumed.initialize(device=Device(backend="cpu"))
+    resumed.run()
+    assert resumed.is_finished
+    assert resumed.loader.epoch_number == 3
+    assert resumed.decision.best_n_err_pt is not None
+
+
+def test_snapshotter_unit_throttling(tmp_path):
+    wf = build(2, tmp_path, snap=True)
+    snap = wf.snapshotter
+    snap.interval = 2
+    snap.time_interval = 0
+    wf.run()
+    # with interval 2, only every second improvement snapshots
+    names = glob.glob(str(tmp_path / "blob*.pickle.gz"))
+    assert len(names) <= 2
+
+
+def test_import_rejects_missing(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        SnapshotterToFile.import_file(str(tmp_path / "nope.pickle"))

@@ -64,6 +64,18 @@ class Device(metaclass=BackendRegistry):
         self._compute_power = None
         self._lock = threading.Lock()
 
+    # Devices ride along in workflow snapshots only as stubs: locks and
+    # PJRT handles cannot pickle, and a restored workflow is re-attached
+    # to a fresh Device by initialize(device=...) anyway (the reference
+    # drops device state the same way, memory.py:284-299).
+    def __getstate__(self):
+        return {}
+
+    def __setstate__(self, state):
+        self._compute_power = None
+        self._lock = threading.Lock()
+        self._devices = []
+
     # -- identity ------------------------------------------------------------
     @property
     def backend_name(self):

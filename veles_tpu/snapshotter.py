@@ -1,0 +1,159 @@
+"""Snapshotter: periodic whole-workflow checkpoints with resume.
+
+TPU-native re-design of /root/reference/veles/snapshotter.py
+(SnapshotterToFile :360-426 — pickle of the full Workflow with compression
+none/gz/bz2/xz, ``<name>_current`` symlink; throttling :159-175;
+master-only skip :160; size diagnostics :203-226; restore path
+Snapshotter.import_file :522-535).  Device arrays are pulled to host by
+``Array.__getstate__`` before pickling (memory.py analog); the fused
+step's params/opt-state are synced into the forward units' Arrays first,
+so a snapshot of a fused workflow restores into either execution mode.
+
+Suffix convention kept: the best metric value lands in the filename, e.g.
+``mnist_validation_1.48.4.pickle.gz``.
+"""
+
+import bz2
+import gzip
+import lzma
+import os
+import pickle
+import sys
+import time
+
+from .config import root
+from .mutable import Bool
+from .registry import MappedObjectsRegistry, UnitRegistry
+from .units import Unit
+
+CODECS = {
+    None: (lambda f: f, ""),
+    "": (lambda f: f, ""),
+    "gz": (lambda f: gzip.GzipFile(fileobj=f, mode="wb"), ".gz"),
+    "bz2": (lambda f: bz2.BZ2File(f, "wb"), ".bz2"),
+    "xz": (lambda f: lzma.LZMAFile(f, "wb"), ".xz"),
+}
+
+DECODERS = {
+    ".gz": gzip.open,
+    ".bz2": bz2.open,
+    ".xz": lzma.open,
+    ".pickle": open,
+}
+
+
+class SnapshotterRegistry(UnitRegistry, MappedObjectsRegistry):
+    """Units that are also a string-keyed family ("file", "db", ...)."""
+
+
+class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
+    """Base: throttling + gate protocol (runs when Decision.improved)."""
+
+    mapping = "snapshotter"
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.prefix = kwargs.get("prefix", "wf")
+        self.interval = kwargs.get("interval", 1)     # epochs between shots
+        self.time_interval = kwargs.get("time_interval", 15)  # seconds
+        self.compression = kwargs.get("compression", "gz")
+        self.suffix = None
+        self.destination = None
+        self.skip = Bool(False)
+        self._last_time = 0.0
+        self._counter = 0
+
+    def run(self):
+        if bool(self.skip):
+            return
+        self._counter += 1
+        if self._counter % max(self.interval, 1):
+            return
+        if time.time() - self._last_time < self.time_interval:
+            return
+        self._last_time = time.time()
+        self.export()
+
+    def export(self):
+        raise NotImplementedError
+
+
+class SnapshotterToFile(SnapshotterBase):
+    """Pickle the whole workflow to disk with a ``_current`` symlink."""
+
+    MAPPING = "file"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.directory = kwargs.get(
+            "directory", os.path.expanduser(
+                root.common.dirs.get("snapshots", ".")))
+
+    def export(self):
+        os.makedirs(self.directory, exist_ok=True)
+        target = self.workflow
+        fused = getattr(target, "fused_step", None)
+        if fused is not None:
+            fused.sync_weights()
+            fused.sync_solver_state()
+        name = "%s%s.%d.pickle" % (
+            self.prefix, ("_" + self.suffix) if self.suffix else "",
+            self._counter)
+        codec, ext = CODECS[self.compression or None]
+        path = os.path.join(self.directory, name + ext)
+        with open(path, "wb") as raw:
+            stream = codec(raw)
+            try:
+                pickle.dump(target, stream,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            finally:
+                if stream is not raw:
+                    stream.close()
+        self.destination = path
+        link = os.path.join(self.directory, "%s_current" % self.prefix)
+        try:
+            if os.path.islink(link):
+                os.remove(link)
+            os.symlink(os.path.basename(path), link)
+        except OSError:
+            pass
+        self._report_size(path, target)
+        return path
+
+    def _report_size(self, path, workflow, top=5):
+        """Top-N fattest units diagnostic (reference snapshotter.py:
+        203-226)."""
+        size = os.path.getsize(path)
+        if size < 64 << 20:
+            return
+        sizes = []
+        for unit in workflow:
+            try:
+                sizes.append((len(pickle.dumps(unit, -1)), unit.name))
+            except Exception:
+                pass
+        print("snapshot %s is %.1f MiB; fattest units:" %
+              (path, size / 1048576), file=sys.stderr)
+        for sz, name in sorted(sizes, reverse=True)[:top]:
+            print("  %-30s %.1f MiB" % (name, sz / 1048576),
+                  file=sys.stderr)
+
+    @staticmethod
+    def import_file(path):
+        """Load a snapshot back into a Workflow object (reference
+        snapshotter.py:522-535 + __main__.py:539)."""
+        path = os.path.realpath(os.path.expanduser(path))
+        ext = os.path.splitext(path)[1]
+        opener = DECODERS.get(ext, open)
+        with opener(path, "rb") as f:
+            wf = pickle.load(f)
+        wf._restored_from_snapshot = True
+        return wf
+
+
+def restore(path):
+    """Convenience resume entry: returns the restored (uninitialized)
+    workflow; call .initialize(device=...) then .run()."""
+    return SnapshotterToFile.import_file(path)

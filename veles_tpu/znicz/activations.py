@@ -32,6 +32,10 @@ class Activation:
         self.deriv_jnp = deriv_jnp
         self.deriv_np = deriv_np
 
+    def __reduce__(self):
+        # pickles by name (the lambdas are module-level table entries)
+        return (get, (self.name,))
+
 
 def _make_table():
     import jax.numpy as jnp

@@ -167,8 +167,13 @@ class FusedTrainStep(Unit, IResultProvider):
         self._params_ = [
             {k: jnp.array(v) for k, v in fwd.params.items()}
             for fwd in forwards]
+        # solver state: restored from the GD units' pickled state when
+        # resuming a snapshot, else freshly initialized
         self._opt_ = [
-            {name: gd.solver.init(p, jnp)
+            {name: (tuple(jnp.asarray(s) for s in
+                          gd.solver_state[name])
+                    if gd.solver_state.get(name) else
+                    gd.solver.init(p, jnp))
              for name, p in self._params_[i].items()}
             for i, gd in enumerate(gds)]
 
@@ -218,6 +223,16 @@ class FusedTrainStep(Unit, IResultProvider):
         import jax.numpy as jnp
         for fwd, p in zip(self.forwards, self._params_):
             fwd.set_params({k: jnp.array(v) for k, v in p.items()})
+
+    def sync_solver_state(self):
+        """Pull the fused optimizer state into the GD units' picklable
+        ``solver_state`` (host numpy) — called before snapshotting so a
+        resumed run continues with intact momentum/accumulators."""
+        import numpy
+        for gd, layer in zip(self.gd_units, self._opt_):
+            for name, state in layer.items():
+                gd.solver_state[name] = tuple(
+                    numpy.asarray(s) for s in state)
 
     def get_metric_values(self):
         return {"n_err": int(self.n_err[0]),

@@ -65,6 +65,8 @@ class StandardWorkflow(Workflow):
         self.epoch_scan = kwargs.get("epoch_scan", False)
         self.decision_config = dict(kwargs.get("decision", {}))
         self.loader_config = dict(kwargs.get("loader", {}))
+        self.snapshotter_config = kwargs.get("snapshotter")  # dict|None
+        self.snapshotter = None
         loader_factory = kwargs.get("loader_factory")
         if loader_factory is None:
             raise ValueError("StandardWorkflow requires loader_factory")
@@ -140,6 +142,17 @@ class StandardWorkflow(Workflow):
             gd.link_forward(fwd)
             self.gds.append(gd)
 
+        if self.snapshotter_config is not None:
+            from ..snapshotter import SnapshotterToFile
+            self.snapshotter = SnapshotterToFile(
+                self, **self.snapshotter_config)
+            # snapshot at epoch boundaries where validation improved
+            # (reference standard workflow gating); without the epoch_ended
+            # conjunct every train-minibatch pass after an improvement
+            # would snapshot again
+            self.snapshotter.skip = ~(self.decision.improved &
+                                      self.loader.epoch_ended)
+
         if self.fused:
             self._build_fused()
         else:
@@ -188,8 +201,9 @@ class StandardWorkflow(Workflow):
         self.decision.link_from(self.fused_step)
         self.decision.link_loader(self.loader)
         self.decision.link_evaluator(self.fused_step)
-        self.repeater.link_from(self.decision)
-        self.end_point.link_from(self.decision)
+        tail = self._link_snapshotter(self.decision)
+        self.repeater.link_from(tail)
+        self.end_point.link_from(tail)
 
     def _build_graph(self):
         last_fwd = self.forwards[-1]
@@ -209,7 +223,7 @@ class StandardWorkflow(Workflow):
         self.decision.link_loader(self.loader)
         self.decision.link_evaluator(self.evaluator)
 
-        prev = self.decision
+        prev = self._link_snapshotter(self.decision)
         train_gate = self.make_train_gate(self.loader)
         for i in reversed(range(len(self.forwards))):
             gd = self.gds[i]
@@ -225,6 +239,34 @@ class StandardWorkflow(Workflow):
             prev = gd
         self.repeater.link_from(prev)
         self.end_point.link_from(prev)
+
+    def _link_snapshotter(self, tail):
+        if self.snapshotter is None:
+            return tail
+        self.snapshotter.link_from(tail)
+        return self.snapshotter
+
+    def initialize(self, device=None, **kwargs):
+        if self.restored_from_snapshot:
+            self._relink_gates()
+        return super().initialize(device=device, **kwargs)
+
+    def _relink_gates(self):
+        """Derived Bool expressions flatten to constants on pickle; rebuild
+        them from the live Decision/loader after a restore."""
+        from ..mutable import Bool
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.gate_block = ~self.decision.complete
+        self.decision.complete <<= False
+        if self.snapshotter is not None:
+            self.snapshotter.skip = ~(self.decision.improved &
+                                      self.loader.epoch_ended)
+        if self.epoch_scan:
+            self.loader.gate_block = Bool(True)
+        if not self.fused:
+            train_gate = self.make_train_gate(self.loader)
+            for gd in self.gds:
+                gd.gate_skip = train_gate
 
     def run(self):
         result = super().run()
