@@ -88,6 +88,21 @@ def test_snapshotter_unit_throttling(tmp_path):
     assert len(names) <= 2
 
 
+def test_snapshot_best_metric_suffix(tmp_path):
+    """Improved-model snapshots carry the best validation metric in the
+    filename (reference validation_1.48 convention) and ignore the time
+    throttle for improvements (ADVICE r1)."""
+    wf = build(3, tmp_path, snap=True)
+    wf.snapshotter.time_interval = 10 ** 6  # would drop every shot if the
+    wf.run()                                # improvement bypass were absent
+    names = [os.path.basename(p)
+             for p in glob.glob(str(tmp_path / "blob*.pickle.gz"))]
+    assert names, "improvement snapshots were throttled away"
+    assert any("validation_" in n for n in names), names
+    best = "validation_%.2f" % wf.decision.best_n_err_pt
+    assert any(best in n for n in names), (best, names)
+
+
 def test_import_rejects_missing(tmp_path):
     with pytest.raises(FileNotFoundError):
         SnapshotterToFile.import_file(str(tmp_path / "nope.pickle"))

@@ -203,3 +203,18 @@ def test_graph_mode_training_converges():
     assert wf.is_finished
     assert decision.best_n_err_pt is not None
     assert decision.best_n_err_pt < 10.0, decision.best_n_err_pt
+
+
+def test_resizable_all2all_resets_output():
+    """After resize() the output Array must report the new width, not the
+    stale buffer's (ADVICE r1)."""
+    from veles_tpu.znicz.all2all import ResizableAll2All
+    wf = Workflow(name="resize")
+    unit = ResizableAll2All(wf, output_sample_shape=8,
+                            prng=RandomGenerator().seed(7))
+    unit.input = Array(numpy.zeros((4, 6), numpy.float32))
+    unit.initialize(device=Device(backend="cpu"))
+    assert unit.output.shape == (4, 8)
+    unit.resize(12)
+    assert unit.output.shape == (4, 12)
+    assert unit.weights.map_read().shape == (6, 12)

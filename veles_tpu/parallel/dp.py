@@ -59,21 +59,20 @@ class DistributedTrainStep(FusedTrainStep):
         self._opt_ = jax.device_put(self._opt_, opt_shard)
 
         # re-jit the two steps with explicit shardings; XLA lowers the
-        # gradient reduction to an ICI all-reduce
-        raw_train = self._train_step_.__wrapped__
-        raw_eval = self._eval_step_.__wrapped__
+        # gradient reduction to an ICI all-reduce.  ``size`` and ``seed``
+        # stay DYNAMIC (replicated scalars) — a static size would trigger a
+        # full recompile of the sharded step for every distinct tail-batch
         self._macc_ = jax.device_put(self._macc_, scalar)
         self._train_step_ = jax.jit(
-            raw_train,
+            self._train_step_.__wrapped__,
             in_shardings=(param_shard, opt_shard, scalar, batch_shard,
-                          label_shard, scalar),
+                          label_shard, scalar, scalar),
             out_shardings=(param_shard, opt_shard, scalar, scalar,
                            batch_shard),
-            static_argnums=(5,),
             donate_argnums=(0, 1, 2))
         self._eval_step_ = jax.jit(
-            raw_eval,
-            in_shardings=(param_shard, scalar, batch_shard, label_shard),
+            self._eval_step_.__wrapped__,
+            in_shardings=(param_shard, scalar, batch_shard, label_shard,
+                          scalar),
             out_shardings=(scalar, scalar, batch_shard),
-            static_argnums=(4,),
             donate_argnums=(1,))

@@ -62,8 +62,43 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         self.suffix = None
         self.destination = None
         self.skip = Bool(False)
+        self.decision = None
         self._last_time = 0.0
         self._counter = 0
+        self._last_exported_best = None
+
+    def link_decision(self, decision):
+        """Wire a Decision so improved-model snapshots carry the best
+        validation metric in the filename (reference snapshotter.py:178-202
+        ``validation_1.48`` convention) and bypass the time throttle — an
+        improvement must never be dropped for landing <15s after the last
+        shot."""
+        self.decision = decision
+        return self
+
+    def _decision_best(self):
+        d = self.decision
+        return (getattr(d, "best_n_err_pt", None),
+                getattr(d, "best_rmse", None),
+                getattr(d, "best_epoch", None))
+
+    def _decision_suffix(self):
+        best_pt, best_rmse, _ = self._decision_best()
+        if best_pt is not None:
+            return "validation_%.2f" % best_pt
+        if best_rmse is not None:
+            return "validation_%.4f" % best_rmse
+        return None
+
+    def _fresh_improvement(self):
+        """Edge-triggered improvement: Decision.improved stays True for a
+        whole epoch after a validation win, so a level check would bypass
+        the time throttle on every minibatch; instead compare the current
+        best to the best at our last export."""
+        d = self.decision
+        if d is None or not bool(d.improved):
+            return False
+        return self._decision_best() != self._last_exported_best
 
     def run(self):
         if bool(self.skip):
@@ -71,9 +106,19 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         self._counter += 1
         if self._counter % max(self.interval, 1):
             return
-        if time.time() - self._last_time < self.time_interval:
+        fresh = self._fresh_improvement()
+        if not fresh and \
+                time.time() - self._last_time < self.time_interval:
             return
         self._last_time = time.time()
+        if fresh:
+            # the suffix names the metric these weights actually achieved;
+            # non-improved periodic shots keep the previous suffix only if
+            # the weights haven't trained past it (they have) — so clear it
+            self.suffix = self._decision_suffix()
+            self._last_exported_best = self._decision_best()
+        elif self.decision is not None:
+            self.suffix = None
         self.export()
 
     def export(self):

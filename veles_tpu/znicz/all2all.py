@@ -161,6 +161,11 @@ class ResizableAll2All(All2All):
             self.fill_array(self.bias, (new_neurons,),
                             self.bias_stddev, self.bias_filling)
             self.bias.map_write()[:keep] = old_b[:keep]
+        if self.output:
+            # downstream units size themselves off output.shape — stale
+            # old-width buffers must not survive a resize
+            self.output.reset(numpy.zeros(
+                (self.output.shape[0], int(new_neurons)), numpy.float32))
         if self.is_initialized and self.device is not None \
                 and self.device.exists:
             self.tpu_init()

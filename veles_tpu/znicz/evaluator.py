@@ -147,7 +147,12 @@ class EvaluatorMSE(EvaluatorBase):
 
     @staticmethod
     def loss_from_output(y, target, mask):
+        """Masked MSE whose gradient wrt ``y`` is exactly ``err / n_valid``
+        — the same effective gradient graph mode produces (evaluator emits
+        ``err = y - t``, the GD units divide by the valid batch size), so
+        fused and graph MSE training match step-for-step.  Value =
+        0.5 * sum-over-features squared error, averaged over valid rows."""
         import jax.numpy as jnp
         err = (y - target).reshape(y.shape[0], -1)
-        per_sample = (err * err).mean(axis=1)
+        per_sample = 0.5 * (err * err).sum(axis=1)
         return (per_sample * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -55,6 +55,7 @@ class FusedTrainStep(Unit, IResultProvider):
         self.metrics = Array(numpy.zeros(3, numpy.float64))
         self.metrics.mem[2] = numpy.inf
         self.confusion_matrix = Array()
+        self.max_err_output_sum = Array(numpy.zeros(1, numpy.float32))
         self.loss = None
         self.output = Array()      # last forward's output (for consumers)
         self.max_idx = Array()
@@ -117,14 +118,44 @@ class FusedTrainStep(Unit, IResultProvider):
                     out, labels_or_targets, mask)
             return data_loss, out
 
-        def metrics_of(out, labels_or_targets, mask):
+        n_classes = int(self.forwards[-1].output.shape[-1]) \
+            if loss_kind == "softmax" else 0
+        self._n_classes = n_classes
+        if loss_kind == "softmax" and not self.confusion_matrix:
+            self.confusion_matrix.mem = numpy.zeros(
+                (n_classes, n_classes), numpy.int64)
+
+        def accumulate(macc, out, labels_or_targets, mask):
+            """Fold one step's outputs into the device-resident metric
+            accumulator.  Matches the graph evaluators' side-channels:
+            softmax → (n_err, confusion[pred, true], max row |err| sum over
+            probabilities); mse → (sum sample-mse, max rmse, min rmse)."""
             if loss_kind == "softmax":
+                n, cm, mx = macc
                 # exact integer count (float32 would lose counts past 2^24)
                 pred = jnp.argmax(out, axis=-1)
                 wrong = (pred != labels_or_targets) & (mask > 0)
-                return wrong.astype(jnp.int32).sum()
+                onehot = jax.nn.one_hot(labels_or_targets, n_classes,
+                                        dtype=out.dtype)
+                err_rows = jnp.abs(out - onehot).sum(axis=1) * mask
+                step_cm = jnp.zeros((n_classes, n_classes), jnp.int32).at[
+                    pred, labels_or_targets].add(mask.astype(jnp.int32))
+                return (n + wrong.astype(jnp.int32).sum(), cm + step_cm,
+                        jnp.maximum(mx, err_rows.max()))
+            sse, mx, mn = macc
             err = (out - labels_or_targets).reshape(out.shape[0], -1)
-            return ((err * err).mean(axis=1) * mask).sum()
+            sample_mse = (err * err).mean(axis=1)
+            rmse = jnp.sqrt(sample_mse)
+            valid = mask > 0
+            return (sse + (sample_mse * mask).sum(),
+                    jnp.maximum(mx, jnp.where(valid, rmse, -jnp.inf).max()),
+                    jnp.minimum(mn, jnp.where(valid, rmse, jnp.inf).min()))
+
+        def observable(out):
+            """What consumers linked to ``output`` see: probabilities for a
+            softmax head (graph-mode All2AllSoftmax.output parity), raw
+            output otherwise.  The loss itself consumed the logits."""
+            return jax.nn.softmax(out) if softmax_head else out
 
         def train_step(params, opt, macc, x, y, size, seed):
             mask = (jnp.arange(x.shape[0]) < size).astype(jnp.float32)
@@ -144,22 +175,22 @@ class FusedTrainStep(Unit, IResultProvider):
                     layer_o[name] = st
                 new_params.append(layer_p)
                 new_opt.append(layer_o)
-            macc = macc + metrics_of(out, y, mask)
+            out = observable(out)
+            macc = accumulate(macc, out, y, mask)
             return new_params, new_opt, macc, loss, out
 
         def eval_step(params, macc, x, y, size):
             mask = (jnp.arange(x.shape[0]) < size).astype(jnp.float32)
             loss, out = loss_fn(params, x, y, mask)
-            return macc + metrics_of(out, y, mask), loss, out
+            out = observable(out)
+            return accumulate(macc, out, y, mask), loss, out
 
         # the metric accumulator stays ON DEVICE between steps and is
         # flushed to the host only at class boundaries — per-step int()
         # pulls would serialize the pipeline on a device sync.  int32 for
         # error counts (exact); float32 for mse sums (flushed per class,
         # so drift stays bounded by one epoch)
-        self._macc_dtype = (jnp.int32 if loss_kind == "softmax"
-                            else jnp.float32)
-        self._macc_ = jnp.zeros((), self._macc_dtype)
+        self._macc_ = self._macc_init()
         self._train_step_ = jax.jit(train_step, donate_argnums=(0, 1, 2))
         self._eval_step_ = jax.jit(eval_step, donate_argnums=(1,))
         # copy: the step donates its param buffers, so they must not alias
@@ -176,6 +207,18 @@ class FusedTrainStep(Unit, IResultProvider):
                     gd.solver.init(p, jnp))
              for name, p in self._params_[i].items()}
             for i, gd in enumerate(gds)]
+
+    def _macc_init(self):
+        """Fresh on-device metric accumulator pytree."""
+        import jax.numpy as jnp
+        if self.loss_kind == "softmax":
+            c = self._n_classes
+            return (jnp.zeros((), jnp.int32),
+                    jnp.zeros((c, c), jnp.int32),
+                    jnp.zeros((), jnp.float32))
+        return (jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.float32),
+                jnp.full((), jnp.inf, jnp.float32))
 
     # -- run -----------------------------------------------------------------
     def run(self):
@@ -202,19 +245,28 @@ class FusedTrainStep(Unit, IResultProvider):
     def _flush_metrics(self):
         """Pull the device accumulator into the evaluator-compatible
         Arrays (one sync per class boundary, not per step)."""
-        import jax.numpy as jnp
-        try:
-            # async D2H then read: avoids the synchronous-transfer RPC
-            # penalty on tunneled/remote devices (~80x on axon)
-            self._macc_.copy_to_host_async()
-        except AttributeError:
-            pass
-        value = float(self._macc_)
-        self._macc_ = jnp.zeros((), self._macc_dtype)
+        macc = self._macc_
+        for leaf in macc:
+            try:
+                # async D2H then read: avoids the synchronous-transfer RPC
+                # penalty on tunneled/remote devices (~80x on axon)
+                leaf.copy_to_host_async()
+            except AttributeError:
+                pass
         if self.loss_kind == "softmax":
-            self.n_err.map_write()[0] += int(round(value))
+            n_err, cm, maxerr = macc
+            self.n_err.map_write()[0] += int(n_err)
+            self.confusion_matrix.map_write()[...] += numpy.asarray(
+                cm, numpy.int64)
+            self.max_err_output_sum.map_write()[0] = max(
+                float(self.max_err_output_sum[0]), float(maxerr))
         else:
-            self.metrics.map_write()[0] += value
+            sse, mx, mn = macc
+            m = self.metrics.map_write()
+            m[0] += float(sse)
+            m[1] = max(m[1], float(mx))
+            m[2] = min(m[2], float(mn))
+        self._macc_ = self._macc_init()
 
     def sync_weights(self):
         """Reflect the fused params back into the forward units' Arrays.

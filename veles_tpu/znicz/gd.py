@@ -78,10 +78,12 @@ class GDStrictRELU(GradientDescent):
 
 
 class GDSoftmax(GradientDescent):
-    """Backward for All2AllSoftmax.  The evaluator already emits
-    ``err_output = (y - onehot)/B`` — the exact cross-entropy gradient wrt
-    the logits — so no derivative multiply happens here (reference GDSoftmax
-    contract with EvaluatorSoftmax)."""
+    """Backward for All2AllSoftmax.  The evaluator emits
+    ``err_output = (y - onehot)`` — the cross-entropy gradient wrt the
+    logits, not yet divided by batch size; ``_linear_bwd`` performs the
+    single division by the valid batch count.  No activation-derivative
+    multiply happens here (reference GDSoftmax contract with
+    EvaluatorSoftmax)."""
 
     MAPPING = "softmax"
     ACTIVATION = "linear"

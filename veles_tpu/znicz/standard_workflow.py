@@ -146,6 +146,7 @@ class StandardWorkflow(Workflow):
             from ..snapshotter import SnapshotterToFile
             self.snapshotter = SnapshotterToFile(
                 self, **self.snapshotter_config)
+            self.snapshotter.link_decision(self.decision)
             # snapshot at epoch boundaries where validation improved
             # (reference standard workflow gating); without the epoch_ended
             # conjunct every train-minibatch pass after an improvement
