@@ -103,6 +103,32 @@ def test_snapshot_best_metric_suffix(tmp_path):
     assert any(best in n for n in names), (best, names)
 
 
+def test_snapshot_weights_scored_the_named_metric(tmp_path):
+    """A restored ``validation_X`` snapshot must contain the weights that
+    actually scored X — the shot is taken at validation end, before the
+    next train pass mutates them (code-review r2)."""
+    import re
+    wf = build(4, tmp_path, snap=True)
+    wf.snapshotter.time_interval = 0
+    wf.run()
+    best = sorted(glob.glob(str(tmp_path / "blob_validation_*.pickle.gz")),
+                  key=lambda p: float(
+                      re.search(r"validation_([0-9.]+?)\.\d+\.pickle",
+                                os.path.basename(p)).group(1)))[0]
+    claimed = float(re.search(r"validation_([0-9.]+?)\.\d+\.pickle",
+                              os.path.basename(best)).group(1))
+    resumed = restore(best)
+    # freeze training: evaluate the restored weights on the validation set
+    resumed.decision.max_epochs = resumed.loader.epoch_number + 1
+    for gd in resumed.gds:
+        gd.learning_rate = 0.0
+        gd.learning_rate_bias = 0.0
+    resumed.initialize(device=Device(backend="cpu"))
+    resumed.run()
+    measured = resumed.decision.epoch_n_err_pt[1]
+    assert measured <= claimed + 1e-6, (claimed, measured)
+
+
 def test_import_rejects_missing(tmp_path):
     with pytest.raises(FileNotFoundError):
         SnapshotterToFile.import_file(str(tmp_path / "nope.pickle"))

@@ -72,6 +72,7 @@ class Loader(Unit, IResultProvider):
         self.last_minibatch = Bool(False)
         self.epoch_ended = Bool(False)
         self.train_ended = Bool(False)
+        self.valid_ended = Bool(False)
         self.epoch_number = 0
         self.samples_served = 0
         self.shuffled_indices = Array()
@@ -238,12 +239,14 @@ class Loader(Unit, IResultProvider):
         if outstanding:
             self.last_minibatch <<= False
             self.train_ended <<= False
+            self.valid_ended <<= False
             self.epoch_ended <<= False
             return
         cls = self.class_of_offset(self._global_offset)
         done = self._global_offset >= self._class_end(cls)
         self.last_minibatch <<= done
         self.train_ended <<= done and cls == TRAIN
+        self.valid_ended <<= done and cls == VALID
         # epoch ends once the last class with samples completes
         last_cls = TRAIN if self.class_lengths[TRAIN] else (
             VALID if self.class_lengths[VALID] else TEST)
@@ -318,6 +321,7 @@ class Loader(Unit, IResultProvider):
         self.last_minibatch <<= False
         self.epoch_ended <<= False
         self.train_ended <<= False
+        self.valid_ended <<= False
         indices = data["indices"]
         if indices.size != self.minibatch_size:
             raise LoaderError("minibatch size mismatch")

@@ -70,9 +70,11 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
     def link_decision(self, decision):
         """Wire a Decision so improved-model snapshots carry the best
         validation metric in the filename (reference snapshotter.py:178-202
-        ``validation_1.48`` convention) and bypass the time throttle — an
-        improvement must never be dropped for landing <15s after the last
-        shot."""
+        ``validation_1.48`` convention) and bypass the *time* throttle — an
+        improvement is never dropped merely for landing <``time_interval``
+        seconds after the last shot.  The ``interval`` counter gate is NOT
+        bypassed: it is an explicit every-Nth thinning knob the user asked
+        for, and applies to improvements like everything else."""
         self.decision = decision
         return self
 

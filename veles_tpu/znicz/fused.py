@@ -56,6 +56,11 @@ class FusedTrainStep(Unit, IResultProvider):
         self.metrics.mem[2] = numpy.inf
         self.confusion_matrix = Array()
         self.max_err_output_sum = Array(numpy.zeros(1, numpy.float32))
+        # the [C, C] accumulator rides the jitted carry; for large class
+        # counts (C^2 ints per device, one_hot + scatter-add per step) turn
+        # it off like the graph evaluator's knob (evaluator.py)
+        self.compute_confusion_matrix = bool(
+            kwargs.get("compute_confusion_matrix", True))
         self.loss = None
         self.output = Array()      # last forward's output (for consumers)
         self.max_idx = Array()
@@ -121,7 +126,8 @@ class FusedTrainStep(Unit, IResultProvider):
         n_classes = int(self.forwards[-1].output.shape[-1]) \
             if loss_kind == "softmax" else 0
         self._n_classes = n_classes
-        if loss_kind == "softmax" and not self.confusion_matrix:
+        with_cm = self.compute_confusion_matrix
+        if loss_kind == "softmax" and with_cm and not self.confusion_matrix:
             self.confusion_matrix.mem = numpy.zeros(
                 (n_classes, n_classes), numpy.int64)
 
@@ -138,9 +144,11 @@ class FusedTrainStep(Unit, IResultProvider):
                 onehot = jax.nn.one_hot(labels_or_targets, n_classes,
                                         dtype=out.dtype)
                 err_rows = jnp.abs(out - onehot).sum(axis=1) * mask
-                step_cm = jnp.zeros((n_classes, n_classes), jnp.int32).at[
-                    pred, labels_or_targets].add(mask.astype(jnp.int32))
-                return (n + wrong.astype(jnp.int32).sum(), cm + step_cm,
+                if with_cm:
+                    cm = cm + jnp.zeros(
+                        (n_classes, n_classes), jnp.int32).at[
+                        pred, labels_or_targets].add(mask.astype(jnp.int32))
+                return (n + wrong.astype(jnp.int32).sum(), cm,
                         jnp.maximum(mx, err_rows.max()))
             sse, mx, mn = macc
             err = (out - labels_or_targets).reshape(out.shape[0], -1)
@@ -212,7 +220,7 @@ class FusedTrainStep(Unit, IResultProvider):
         """Fresh on-device metric accumulator pytree."""
         import jax.numpy as jnp
         if self.loss_kind == "softmax":
-            c = self._n_classes
+            c = self._n_classes if self.compute_confusion_matrix else 0
             return (jnp.zeros((), jnp.int32),
                     jnp.zeros((c, c), jnp.int32),
                     jnp.zeros((), jnp.float32))
@@ -256,8 +264,9 @@ class FusedTrainStep(Unit, IResultProvider):
         if self.loss_kind == "softmax":
             n_err, cm, maxerr = macc
             self.n_err.map_write()[0] += int(n_err)
-            self.confusion_matrix.map_write()[...] += numpy.asarray(
-                cm, numpy.int64)
+            if self.compute_confusion_matrix:
+                self.confusion_matrix.map_write()[...] += numpy.asarray(
+                    cm, numpy.int64)
             self.max_err_output_sum.map_write()[0] = max(
                 float(self.max_err_output_sum[0]), float(maxerr))
         else:

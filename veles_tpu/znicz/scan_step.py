@@ -146,6 +146,7 @@ class ScanEpochStep(FusedTrainStep):
         self._class_cursor = 0 if last else self._class_cursor + 1
         ld.last_minibatch <<= True
         ld.train_ended <<= cls == loader_mod.TRAIN
+        ld.valid_ended <<= cls == loader_mod.VALID
         ld.epoch_ended <<= last
         if last:
             self._epochs_done += 1

@@ -147,12 +147,13 @@ class StandardWorkflow(Workflow):
             self.snapshotter = SnapshotterToFile(
                 self, **self.snapshotter_config)
             self.snapshotter.link_decision(self.decision)
-            # snapshot at epoch boundaries where validation improved
-            # (reference standard workflow gating); without the epoch_ended
-            # conjunct every train-minibatch pass after an improvement
-            # would snapshot again
+            # snapshot the moment validation improves — BEFORE the next
+            # train pass mutates the weights — so a restored
+            # ``validation_X`` snapshot really is the model that scored X;
+            # without the valid_ended conjunct every train-minibatch pass
+            # after an improvement would snapshot again
             self.snapshotter.skip = ~(self.decision.improved &
-                                      self.loader.epoch_ended)
+                                      self.loader.valid_ended)
 
         if self.fused:
             self._build_fused()
@@ -261,7 +262,7 @@ class StandardWorkflow(Workflow):
         self.decision.complete <<= False
         if self.snapshotter is not None:
             self.snapshotter.skip = ~(self.decision.improved &
-                                      self.loader.epoch_ended)
+                                      self.loader.valid_ended)
         if self.epoch_scan:
             self.loader.gate_block = Bool(True)
         if not self.fused:
