@@ -1,0 +1,124 @@
+"""CLI + Launcher tests (reference test strategy §4.5 test_launcher.py):
+the ``python -m veles_tpu workflow.py config.py`` surface — module loading,
+config override ordering, run(load, main) convention, dry-run levels,
+snapshot resume, and the result-file JSON."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MNIST = os.path.join(REPO, "veles_tpu", "znicz", "samples", "mnist.py")
+
+TINY = ["root.mnist.loader.n_train=300", "root.mnist.loader.n_valid=100",
+        "root.mnist.decision.max_epochs=2"]
+
+
+def run_cli(*argv, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "veles_tpu"] + list(argv),
+        cwd=REPO, capture_output=True, text=True, timeout=timeout)
+
+
+def test_parse_mesh():
+    from veles_tpu.__main__ import parse_mesh
+    assert parse_mesh("data=8") == {"data": 8}
+    assert parse_mesh("data=4,model=2") == {"data": 4, "model": 2}
+    with pytest.raises(Exception):
+        parse_mesh("data")
+
+
+def test_parse_value():
+    from veles_tpu.__main__ import _parse_value
+    assert _parse_value("3") == 3
+    assert _parse_value("0.5") == 0.5
+    assert _parse_value("[1, 2]") == [1, 2]
+    assert _parse_value("True") is True
+    assert _parse_value("hello") == "hello"
+
+
+def test_import_workflow_module_by_path_and_name():
+    from veles_tpu.__main__ import import_workflow_module
+    m1 = import_workflow_module(MNIST)
+    assert hasattr(m1, "run") and hasattr(m1, "create_workflow")
+    m2 = import_workflow_module("veles_tpu.znicz.samples.mnist")
+    assert hasattr(m2, "run")
+
+
+def test_dry_run_load_builds_without_device():
+    """--dry-run load must build the workflow and stop before initialize."""
+    from veles_tpu.__main__ import Main
+    main = Main([MNIST] + TINY + ["--dry-run", "load", "--backend", "cpu"])
+    assert main.run() == 0
+    wf = main.workflow
+    assert wf is not None
+    assert wf.decision.max_epochs == 2       # override took effect
+    assert not wf.is_finished
+
+
+def test_override_order_beats_module_defaults():
+    """CLI overrides are applied AFTER the module registers its defaults."""
+    from veles_tpu.__main__ import Main
+    main = Main([MNIST, "root.mnist.decision.max_epochs=7",
+                 "--dry-run", "load"])
+    main.run()
+    assert main.workflow.decision.max_epochs == 7
+
+
+def test_cli_end_to_end_and_resume(tmp_path):
+    """Full subprocess run: train 2 epochs with snapshots, write results,
+    then resume from the snapshot and extend with --set."""
+    snapdir = str(tmp_path / "snaps")
+    result1 = str(tmp_path / "r1.json")
+    r = run_cli(MNIST, *TINY,
+                "root.mnist.snapshotter.prefix=mnist",
+                "root.mnist.snapshotter.directory=" + snapdir,
+                "root.mnist.snapshotter.time_interval=0",
+                "--backend", "cpu", "--random-seed", "7",
+                "--result-file", result1)
+    assert r.returncode == 0, r.stderr[-2000:]
+    results = json.load(open(result1))
+    assert results["name"] == "MnistSimple"
+    assert results["best_validation_error_pt"] is not None
+    current = os.path.join(snapdir, "mnist_current")
+    assert os.path.islink(current)
+
+    result2 = str(tmp_path / "r2.json")
+    r = run_cli(MNIST, "--backend", "cpu",
+                "--snapshot", current,
+                "--set", "decision.max_epochs=3",
+                "--result-file", result2)
+    assert r.returncode == 0, r.stderr[-2000:]
+    resumed = json.load(open(result2))
+    assert resumed["Total epochs"] >= 2
+
+
+def test_cli_visualize_and_dry_run_init(tmp_path):
+    dot = str(tmp_path / "wf.dot")
+    r = run_cli(MNIST, *TINY, "--backend", "cpu",
+                "--dry-run", "init", "--visualize", dot)
+    assert r.returncode == 0, r.stderr[-2000:]
+    text = open(dot).read()
+    assert "digraph" in text and "MnistLoader" in text
+
+
+def test_launcher_standalone():
+    """Launcher drives a workflow end-to-end programmatically."""
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.znicz.samples import mnist
+    wf = mnist.create_workflow(
+        loader={"minibatch_size": 60, "n_train": 300, "n_valid": 100,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 1, "silent": True})
+    launcher = Launcher(backend="cpu")
+    launcher.add_workflow(wf)
+    launcher.initialize()
+    launcher.run()
+    assert wf.is_finished
+    results = launcher.gather_results()
+    assert results["backend"] == "cpu"
+    assert "seconds" in results

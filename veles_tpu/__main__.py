@@ -1,0 +1,251 @@
+"""Command line interface: ``python -m veles_tpu <workflow.py> [config.py]``.
+
+TPU-native re-creation of /root/reference/veles/__main__.py:136-726.  The
+capability surface kept from the reference CLI:
+
+- workflow module loading by file path or dotted module name
+  (reference import_file.py:50,66), config file application, then
+  ``root.x.y=value`` command-line overrides (reference __main__.py:432-478);
+- the ``run(load, main)`` module convention (reference
+  manualrst_veles_workflow_creation.rst:30-39, __main__.py:591-726);
+- ``--snapshot`` resume (reference __main__.py:539-589 — file source; odbc/
+  http sources intentionally dropped in the zero-egress build);
+- deterministic seeding via ``--random-seed`` (reference :483-539);
+- ``--dry-run`` levels load/init/exec (reference cmdline.py);
+- ``--result-file``, ``--dump-config``, ``--visualize`` (dot graph);
+- backend selection ``--backend`` (reference ``-a/--accelerator``).
+
+TPU-native additions (replacing the master/slave flags): ``--mesh
+data=8,model=2`` + ``--model-axis`` request an SPMD run over a device
+mesh; ``--mode fused|graph|scan`` picks the execution strategy
+(SURVEY.md §7 design stance).
+"""
+
+import argparse
+import ast
+import importlib
+import importlib.util
+import os
+import sys
+
+from .config import root, fix_config, set_config_by_path
+from .launcher import Launcher
+
+
+def _parse_value(text):
+    """Parse an override value: python literal if possible, else string."""
+    try:
+        return ast.literal_eval(text)
+    except (ValueError, SyntaxError):
+        return text
+
+
+def import_workflow_module(spec):
+    """Import a workflow module from a file path or dotted module name
+    (reference import_file.py:50-66 package-or-module logic).  A file that
+    lives inside a package tree (``__init__.py`` chain) is imported by its
+    dotted name so its relative imports resolve."""
+    if not os.path.exists(spec):
+        return importlib.import_module(spec)
+    path = os.path.abspath(spec)
+    name = os.path.splitext(os.path.basename(path))[0]
+    # climb the package chain
+    parts, d = [name], os.path.dirname(path)
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parts.insert(0, os.path.basename(d))
+        d = os.path.dirname(d)
+    if len(parts) > 1:
+        if d not in sys.path:
+            sys.path.insert(0, d)
+        return importlib.import_module(".".join(parts))
+    module_spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(module_spec)
+    sys.modules[name] = module
+    module_spec.loader.exec_module(module)
+    return module
+
+
+def apply_config_file(path):
+    """Execute a config file with ``root`` in scope (the reference runpy
+    convention, __main__.py:432)."""
+    with open(path) as f:
+        source = f.read()
+    exec(compile(source, path, "exec"), {"root": root})
+
+
+def parse_mesh(text):
+    """``data=8,model=2`` → {"data": 8, "model": 2}."""
+    axes = {}
+    for part in text.split(","):
+        name, _, size = part.partition("=")
+        if not size:
+            raise argparse.ArgumentTypeError(
+                "mesh axis %r needs =SIZE" % part)
+        axes[name.strip()] = int(size)
+    return axes
+
+
+def make_parser():
+    p = argparse.ArgumentParser(
+        prog="veles_tpu",
+        description="TPU-native VELES: run a workflow module.")
+    p.add_argument("workflow", nargs="?",
+                   help="workflow module (.py path or dotted name)")
+    p.add_argument("config", nargs="?",
+                   help="config file applied before overrides")
+    p.add_argument("overrides", nargs="*", metavar="root.x.y=value",
+                   help="config overrides")
+    p.add_argument("-s", "--snapshot", default=None,
+                   help="resume from a snapshot file")
+    p.add_argument("--random-seed", type=int, default=None,
+                   help="seed for the deterministic PRNG tree")
+    p.add_argument("-a", "--backend", default=None,
+                   choices=("auto", "tpu", "cpu", "numpy"),
+                   help="compute backend (default: config)")
+    p.add_argument("--mode", default=None,
+                   choices=("fused", "graph", "scan"),
+                   help="execution strategy (default: workflow's)")
+    p.add_argument("--mesh", type=parse_mesh, default=None,
+                   metavar="data=8[,model=2]",
+                   help="SPMD device mesh axes")
+    p.add_argument("--model-axis", default=None,
+                   help="mesh axis for tensor parallelism")
+    p.add_argument("--set", action="append", default=[], dest="sets",
+                   metavar="attr.path=value",
+                   help="set a workflow attribute after build/restore "
+                        "(e.g. --set decision.max_epochs=50); the way to "
+                        "extend a resumed run past its pickled limits")
+    p.add_argument("--dry-run", default="exec",
+                   choices=("load", "init", "exec"),
+                   help="stop after load/init (default: full run)")
+    p.add_argument("--result-file", default=None,
+                   help="write gathered results JSON here ('-' = stdout)")
+    p.add_argument("--dump-config", action="store_true",
+                   help="print the effective config tree and exit")
+    p.add_argument("--visualize", default=None, metavar="FILE.dot",
+                   help="write the unit graph in dot format")
+    p.add_argument("--stats", action="store_true",
+                   help="print per-unit timing stats after the run")
+    p.add_argument("--no-fix-config", action="store_true",
+                   help="keep Range placeholders (genetic optimizer use)")
+    return p
+
+
+class Main:
+    """CLI driver implementing the reference ``run(load, main)`` contract
+    (reference __main__.py:136,591-726)."""
+
+    def __init__(self, argv=None):
+        self.args = make_parser().parse_args(argv)
+        self.launcher = None
+        self.workflow = None
+        self.snapshot_loaded = False
+
+    # -- the two callbacks handed to the workflow module ---------------------
+    def _load(self, factory, **kwargs):
+        """Build the workflow (or restore it from ``--snapshot``); returns
+        (workflow, was_restored)."""
+        args = self.args
+        if args.snapshot:
+            if args.mesh or args.model_axis or args.mode:
+                raise SystemExit(
+                    "--mesh/--model-axis/--mode cannot be applied to a "
+                    "restored snapshot (the pickled workflow keeps its "
+                    "build-time execution strategy); rebuild without "
+                    "--snapshot, or restore and resume as-is")
+            from .snapshotter import restore
+            self.workflow = restore(args.snapshot)
+            self.snapshot_loaded = True
+        else:
+            if args.mode == "graph":
+                kwargs.setdefault("fused", False)
+            elif args.mode == "scan":
+                kwargs.setdefault("epoch_scan", True)
+            elif args.mode == "fused":
+                kwargs.setdefault("fused", True)
+            if args.mesh:
+                from .parallel.mesh import make_mesh
+                kwargs.setdefault("mesh", make_mesh(args.mesh))
+                if args.model_axis:
+                    kwargs.setdefault("model_axis", args.model_axis)
+            self.workflow = factory(**kwargs)
+        for assignment in args.sets:
+            path, _, value = assignment.partition("=")
+            if not value:
+                raise SystemExit("--set %r needs =value" % assignment)
+            obj = self.workflow
+            parts = path.split(".")
+            for p in parts[:-1]:
+                obj = getattr(obj, p)
+            setattr(obj, parts[-1], _parse_value(value))
+        self.launcher.add_workflow(self.workflow)
+        return self.workflow, self.snapshot_loaded
+
+    def _main(self, **kwargs):
+        args = self.args
+        if args.dry_run == "load":
+            return self.workflow
+        self.launcher.initialize(**kwargs)
+        if args.visualize:
+            self.workflow.generate_graph(args.visualize)
+        if args.dry_run == "init":
+            return self.workflow
+        self.launcher.run()
+        if args.stats:
+            self.launcher.print_stats()
+        return self.workflow
+
+    # -- entry ---------------------------------------------------------------
+    def run(self):
+        args = self.args
+        if args.config is not None and "=" in args.config \
+                and not os.path.exists(args.config):
+            # `workflow.py root.x=1` without a config file
+            args.overrides.insert(0, args.config)
+            args.config = None
+        if not args.workflow:
+            if args.dump_config:
+                root.print_()
+                return 0
+            make_parser().print_help()
+            return 2
+        # the module import registers the workflow's config DEFAULTS; the
+        # config file, then the CLI overrides, are applied on top of them
+        # (reference order: _load_model :401 before _apply_config :432)
+        module = import_workflow_module(args.workflow)
+        if args.config:
+            apply_config_file(args.config)
+        for override in args.overrides:
+            path, _, value = override.partition("=")
+            if not value:
+                raise SystemExit("override %r needs =value" % override)
+            set_config_by_path(root, path, _parse_value(value))
+        if not args.no_fix_config:
+            fix_config(root)
+        if args.dump_config:
+            root.print_()
+            return 0
+        seed = args.random_seed
+        if seed is None:
+            seed = root.common.get("random_seed", 1234)
+        from . import prng
+        prng.get(0).seed(int(seed))
+        self.launcher = Launcher(backend=args.backend,
+                                 result_file=args.result_file)
+        if not hasattr(module, "run"):
+            raise SystemExit(
+                "workflow module %r does not define run(load, main)"
+                % args.workflow)
+        module.run(self._load, self._main)
+        wf = self.workflow
+        if wf is not None and args.dry_run == "exec" and not wf.is_finished:
+            return 1  # unit queue drained without reaching the end point
+        return 0
+
+
+def main(argv=None):
+    return Main(argv).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""Launcher: the runtime owner that takes a built workflow end-to-end.
+
+TPU-native re-design of /root/reference/veles/launcher.py:100-906.  The
+reference Launcher's job was mode selection (master/slave/standalone), the
+Twisted reactor, SSH node spawning, and service side-cars.  On TPU the
+tensor-level distribution lives *inside* the jitted step (mesh shardings,
+parallel/dp.py), so the Launcher keeps the surviving responsibilities:
+
+- device construction and workflow ``initialize``/``run`` lifecycle
+  (reference launcher.py:431-512, :550-564);
+- run modes: ``standalone`` (this process computes) and the dry-run
+  levels consumed by the CLI (reference __main__.py "--dry-run");
+- results gathering + ``--result-file`` JSON (reference workflow.py:827);
+- per-run stats printing and wall-clock accounting (launcher.py:779-786);
+- graceful stop + finished callbacks;
+- service side-cars (web status reporter, event log) hook in here once
+  built — the attachment points are ``on_initialized``/``on_finished``.
+
+Mesh parallelism is requested by the *workflow* (``mesh=`` kwarg), not the
+launcher; meta-level multi-process scheduling (ensembles, GA) re-invokes
+the CLI per trial, as the reference did via subprocess (SURVEY.md §2.11).
+"""
+
+import sys
+import time
+
+from .config import root
+
+
+class Launcher:
+    """Owns device + lifecycle for one workflow run."""
+
+    def __init__(self, backend=None, result_file=None, stealth=False,
+                 **kwargs):
+        self.backend = backend or root.common.engine.get("backend", "auto")
+        self.result_file = result_file
+        self.stealth = stealth          # no external reporting side-cars
+        self.workflow = None
+        self.device = None
+        self.start_time = None
+        self.finish_time = None
+        self.on_initialized = []        # callbacks(workflow)
+        self.on_finished = []           # callbacks(workflow)
+        self._extra = kwargs
+
+    # -- lifecycle -----------------------------------------------------------
+    def add_workflow(self, workflow):
+        self.workflow = workflow
+        return workflow
+
+    def initialize(self, **kwargs):
+        from .backends import Device
+        if self.workflow is None:
+            raise ValueError("no workflow attached (call add_workflow)")
+        if self.device is None:
+            self.device = Device(backend=self.backend)
+        self.workflow.initialize(device=self.device, **kwargs)
+        for cb in self.on_initialized:
+            cb(self.workflow)
+        return self
+
+    def run(self):
+        self.start_time = time.time()
+        try:
+            self.workflow.run()
+        finally:
+            self.finish_time = time.time()
+        for cb in self.on_finished:
+            cb(self.workflow)
+        if self.result_file:
+            self.write_results(self.result_file)
+        return self.workflow
+
+    def stop(self):
+        if self.workflow is not None:
+            self.workflow.stop()
+
+    # -- results -------------------------------------------------------------
+    def gather_results(self):
+        results = self.workflow.gather_results()
+        results.setdefault("name", self.workflow.name)
+        if self.start_time is not None:
+            results["seconds"] = round(
+                (self.finish_time or time.time()) - self.start_time, 3)
+        results["backend"] = getattr(self.device, "backend", self.backend)
+        return results
+
+    def write_results(self, file):
+        return self.workflow.write_results(file,
+                                           results=self.gather_results())
+
+    def print_stats(self, file=None):
+        self.workflow.print_stats(file=file)
+        if self.start_time is not None:
+            print("Total run time: %.3f s" %
+                  ((self.finish_time or time.time()) - self.start_time),
+                  file=file or sys.stdout)

@@ -94,6 +94,12 @@ class Loader(Unit, IResultProvider):
         super().init_unpickled()
         self.pending_minibatches_ = collections.defaultdict(list)
 
+    def __setstate__(self, state):
+        # snapshots written before the valid_ended Bool existed must still
+        # restore (forward-compat migration)
+        state.setdefault("valid_ended", Bool(False))
+        super().__setstate__(state)
+
     # -- derived sizes -------------------------------------------------------
     @property
     def total_samples(self):

@@ -257,10 +257,15 @@ class Workflow(Container):
                 results.update(unit.get_metric_values())
         return results
 
-    def write_results(self, file=None):
-        results = self.gather_results()
+    def write_results(self, file=None, results=None):
+        """Serialize results JSON (the single serialization path — the
+        Launcher passes its enriched dict through ``results``)."""
+        results = results if results is not None else self.gather_results()
         path = file or self.result_file
-        if path:
+        if path == "-":
+            json.dump(results, sys.stdout, indent=2, default=str)
+            sys.stdout.write("\n")
+        elif path:
             with open(path, "w") as f:
                 json.dump(results, f, indent=2, default=str)
         return results

@@ -101,6 +101,8 @@ def create_workflow(fused=True, **overrides):
     loader = cfg.loader.todict()
     loader.update(overrides.pop("loader", {}))
     layers = overrides.pop("layers", cfg.layers)
+    if "snapshotter" in cfg and "snapshotter" not in overrides:
+        overrides["snapshotter"] = cfg.snapshotter.todict()
     loader_factory = overrides.pop("loader_factory",
                                    SyntheticImagenetLoader)
     return StandardWorkflow(

@@ -123,6 +123,8 @@ def create_workflow(fused=True, **overrides):
     loader = cfg.loader.todict()
     loader.update(overrides.pop("loader", {}))
     layers = overrides.pop("layers", cfg.layers)
+    if "snapshotter" in cfg and "snapshotter" not in overrides:
+        overrides["snapshotter"] = cfg.snapshotter.todict()
     return StandardWorkflow(
         None, name="CifarConvnet",
         loader_factory=CifarLoader,

@@ -65,6 +65,7 @@ class StandardWorkflow(Workflow):
         self.epoch_scan = kwargs.get("epoch_scan", False)
         self.decision_config = dict(kwargs.get("decision", {}))
         self.loader_config = dict(kwargs.get("loader", {}))
+        self.trainer_config = dict(kwargs.get("trainer", {}))
         self.snapshotter_config = kwargs.get("snapshotter")  # dict|None
         self.snapshotter = None
         loader_factory = kwargs.get("loader_factory")
@@ -181,14 +182,16 @@ class StandardWorkflow(Workflow):
             from ..parallel.dp import DistributedTrainStep
             self.fused_step = DistributedTrainStep(
                 self, self.forwards, self.gds, mesh=self.mesh,
-                loss=self.loss_function, model_axis=self.model_axis)
+                loss=self.loss_function, model_axis=self.model_axis,
+                **self.trainer_config)
             self.fused_step.link_from(self.loader)
             self.fused_step.link_loader(self.loader)
         elif self.epoch_scan:
             from ..mutable import Bool
             from .scan_step import ScanEpochStep
             self.fused_step = ScanEpochStep(
-                self, self.forwards, self.gds, loss=self.loss_function)
+                self, self.forwards, self.gds, loss=self.loss_function,
+                **self.trainer_config)
             # the scan step drives the loader itself; the loader stays
             # linked (so it initializes before the scan step in dependency
             # order) but permanently blocked from running
@@ -197,7 +200,8 @@ class StandardWorkflow(Workflow):
             self.fused_step.link_scan_loader(self.loader)
         else:
             self.fused_step = FusedTrainStep(
-                self, self.forwards, self.gds, loss=self.loss_function)
+                self, self.forwards, self.gds, loss=self.loss_function,
+                **self.trainer_config)
             self.fused_step.link_from(self.loader)
             self.fused_step.link_loader(self.loader)
         self.decision.link_from(self.fused_step)
