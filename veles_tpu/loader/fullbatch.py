@@ -33,6 +33,9 @@ class FullBatchLoader(Loader):
         self.original_labels = []
         self.force_numpy = bool(kwargs.get("force_numpy", False))
         self._dtype = kwargs.get("dtype", numpy.float32)
+        # set by FusedTrainStep.link_fused_gather: indices only, the
+        # device gather happens inside the consumer's jitted step
+        self.defer_device_gather = False
 
     def create_minibatch_data(self):
         self.minibatch_data.reset(numpy.zeros(
@@ -98,14 +101,24 @@ class FullBatchLoader(Loader):
     def _device_init(self):
         """Build ONE jitted gather over the declared sources (uploads stay
         resident in HBM; XLA fuses the per-source takes)."""
+        if self.defer_device_gather:
+            # the consumer (FusedTrainStep.link_fused_gather) gathers
+            # inside its own jitted step — building the standalone gather
+            # here would only duplicate the label table in HBM
+            return
         import jax
         import jax.numpy as jnp
         pairs = self._gather_sources()
-        sources = [s for s, _ in pairs]
+        # sources are ARGUMENTS, not closure captures: a closed-over
+        # jax.Array is baked into the HLO as a literal constant, which
+        # bloats the executable by the whole dataset (and overflows remote
+        # compile transports); as arguments they stay HBM-resident buffers
+        # the executable merely reads
+        self._gather_sources_ = tuple(s for s, _ in pairs)
         self._gather_targets_ = [t for _, t in pairs]
 
         @jax.jit
-        def gather(idx):
+        def gather(sources, idx):
             return tuple(jnp.take(src, idx, axis=0) for src in sources)
         self._gather_ = gather
 
@@ -117,7 +130,11 @@ class FullBatchLoader(Loader):
         idx[:count] = self.shuffled_indices[start_offset:start_offset + count]
         if count < self.max_minibatch_size:
             idx[count:] = idx[0]  # pad with a valid index; masked downstream
-        for target, val in zip(self._gather_targets_, self._gather_(idx),
+        self._padded_indices_ = idx
+        if self.defer_device_gather:
+            return True  # consumer gathers inside its own jitted step
+        for target, val in zip(self._gather_targets_,
+                               self._gather_(self._gather_sources_, idx),
                                strict=True):
             target.devmem = val
         return True

@@ -39,6 +39,7 @@ class FusedTrainStep(Unit, IResultProvider):
                  **kwargs):
         super().__init__(workflow, **kwargs)
         self.view_group = "TRAINER"
+        self.gather_loader = None   # set by link_fused_gather
         self.forwards = list(forwards)
         self.gd_units = list(gd_units)
         assert len(self.gd_units) == len(self.forwards)
@@ -76,6 +77,21 @@ class FusedTrainStep(Unit, IResultProvider):
                         "last_minibatch")
         if hasattr(loader, "minibatch_targets"):
             self.link_attrs(loader, "minibatch_targets")
+        return self
+
+    def link_fused_gather(self, loader):
+        """Fuse the HBM-resident minibatch gather INTO the jitted step.
+
+        The loader then only computes shuffled indices host-side; the
+        ``jnp.take`` rides inside the same executable as the forward/
+        backward — one launch per step instead of two.  On tunneled or
+        remote devices each executable launch costs an RTT (~14 ms
+        measured through axon), so separate gather+step launches dominate
+        mid-size models; fusing them is also strictly less HBM traffic
+        (the gathered batch never materializes as a separate buffer
+        between two executables)."""
+        self.gather_loader = loader
+        loader.defer_device_gather = True
         return self
 
     # -- jit construction ----------------------------------------------------
@@ -128,8 +144,13 @@ class FusedTrainStep(Unit, IResultProvider):
         self._n_classes = n_classes
         with_cm = self.compute_confusion_matrix
         if loss_kind == "softmax" and with_cm and not self.confusion_matrix:
+            # int32 throughout: the running total lives on device (jax
+            # default integer width), bounding any one [pred, true] cell
+            # at 2^31 counts — i.e. >2 billion samples routed through a
+            # single cell before wraparound, far past any other counter
             self.confusion_matrix.mem = numpy.zeros(
-                (n_classes, n_classes), numpy.int64)
+                (n_classes, n_classes), numpy.int32)
+        self._cm_dev_ = None    # device-resident running total (flush)
 
         def accumulate(macc, out, labels_or_targets, mask):
             """Fold one step's outputs into the device-resident metric
@@ -145,9 +166,17 @@ class FusedTrainStep(Unit, IResultProvider):
                                         dtype=out.dtype)
                 err_rows = jnp.abs(out - onehot).sum(axis=1) * mask
                 if with_cm:
-                    cm = cm + jnp.zeros(
-                        (n_classes, n_classes), jnp.int32).at[
-                        pred, labels_or_targets].add(mask.astype(jnp.int32))
+                    # scatter-add serializes on the TPU vector unit (a
+                    # measured 22% hit on the MNIST scan bench); the same
+                    # histogram as a one-hot outer product rides the MXU.
+                    # float32 counts are exact here: per-step counts are
+                    # bounded by the batch (< 2^24)
+                    pred_oh = jax.nn.one_hot(
+                        pred, n_classes, dtype=jnp.float32) * mask[:, None]
+                    true_oh = jax.nn.one_hot(
+                        labels_or_targets, n_classes, dtype=jnp.float32)
+                    cm = cm + jnp.einsum(
+                        "bi,bj->ij", pred_oh, true_oh).astype(jnp.int32)
                 return (n + wrong.astype(jnp.int32).sum(), cm,
                         jnp.maximum(mx, err_rows.max()))
             sse, mx, mn = macc
@@ -201,6 +230,37 @@ class FusedTrainStep(Unit, IResultProvider):
         self._macc_ = self._macc_init()
         self._train_step_ = jax.jit(train_step, donate_argnums=(0, 1, 2))
         self._eval_step_ = jax.jit(eval_step, donate_argnums=(1,))
+        # the gather-in-step path needs the dataset resident on a real
+        # device; numpy/force-numpy loaders fill minibatch_data host-side
+        # instead, so fall back to the plain step there
+        self._use_gather_ = (self.gather_loader is not None and
+                             getattr(self.gather_loader, "_use_device",
+                                     False))
+        if self._use_gather_:
+            # gather-in-step variants: the resident dataset rides as an
+            # ARGUMENT (a closed-over jax.Array would be baked into the
+            # HLO as a literal — see loader/fullbatch.py)
+            ld = self.gather_loader
+            self._data_dev_ = ld.original_data.devmem
+            if self.loss_kind == "softmax":
+                self._y_dev_ = jax.device_put(ld._dense_labels)
+            else:
+                self._y_dev_ = ld.original_targets.devmem
+
+            def train_step_g(data, y_all, params, opt, macc, idx, size,
+                             seed):
+                x = jnp.take(data, idx, axis=0)
+                y = jnp.take(y_all, idx, axis=0)
+                return train_step(params, opt, macc, x, y, size, seed)
+
+            def eval_step_g(data, y_all, params, macc, idx, size):
+                x = jnp.take(data, idx, axis=0)
+                y = jnp.take(y_all, idx, axis=0)
+                return eval_step(params, macc, x, y, size)
+
+            self._train_step_g_ = jax.jit(train_step_g,
+                                          donate_argnums=(2, 3, 4))
+            self._eval_step_g_ = jax.jit(eval_step_g, donate_argnums=(3,))
         # copy: the step donates its param buffers, so they must not alias
         # the forward units' live weight Arrays
         self._params_ = [
@@ -230,13 +290,33 @@ class FusedTrainStep(Unit, IResultProvider):
 
     # -- run -----------------------------------------------------------------
     def run(self):
+        size = int(self.minibatch_size)
+        train = self.minibatch_class == loader_mod.TRAIN
+        if getattr(self, "_use_gather_", False):
+            idx = self.gather_loader._padded_indices_
+            if train:
+                self._seed_counter = (self._seed_counter + 1) % 0x7FFF0000
+                (self._params_, self._opt_, self._macc_, loss, out) = \
+                    self._train_step_g_(
+                        self._data_dev_, self._y_dev_, self._params_,
+                        self._opt_, self._macc_, idx, size,
+                        self._seed_counter)
+            else:
+                self._macc_, loss, out = self._eval_step_g_(
+                    self._data_dev_, self._y_dev_, self._params_,
+                    self._macc_, idx, size)
+            self.loss = loss
+            self.output.devmem = out
+            if bool(self.last_minibatch):
+                self._flush_metrics()
+                self.sync_weights()
+            return
         x = self.minibatch_data.devmem
         if self.loss_kind == "softmax":
             y = self.minibatch_labels.devmem
         else:
             y = self.minibatch_targets.devmem
-        size = int(self.minibatch_size)
-        if self.minibatch_class == loader_mod.TRAIN:
+        if train:
             self._seed_counter = (self._seed_counter + 1) % 0x7FFF0000
             (self._params_, self._opt_, self._macc_, loss, out) = \
                 self._train_step_(self._params_, self._opt_, self._macc_,
@@ -253,24 +333,34 @@ class FusedTrainStep(Unit, IResultProvider):
     def _flush_metrics(self):
         """Pull the device accumulator into the evaluator-compatible
         Arrays (one sync per class boundary, not per step)."""
-        macc = self._macc_
-        for leaf in macc:
-            try:
-                # async D2H then read: avoids the synchronous-transfer RPC
-                # penalty on tunneled/remote devices (~80x on axon)
-                leaf.copy_to_host_async()
-            except AttributeError:
-                pass
+        import jax
         if self.loss_kind == "softmax":
-            n_err, cm, maxerr = macc
-            self.n_err.map_write()[0] += int(n_err)
+            n_err, cm, maxerr = self._macc_
             if self.compute_confusion_matrix:
-                self.confusion_matrix.map_write()[...] += numpy.asarray(
-                    cm, numpy.int64)
+                # the [C, C] matrix stays ON DEVICE: pulling it per class
+                # boundary costs C²·4 bytes of D2H (4 MB for ImageNet
+                # heads — ~600 ms through a tunneled link); instead the
+                # running total accumulates device-side and the Array
+                # transfers it lazily only when someone map_read()s it
+                if self._cm_dev_ is None:
+                    host = self.confusion_matrix.mem
+                    if host is not None and host.any():
+                        import jax.numpy as jnp  # resumed: seed from host
+                        self._cm_dev_ = jnp.asarray(
+                            host.astype(numpy.int32)) + cm
+                    else:
+                        self._cm_dev_ = cm
+                else:
+                    self._cm_dev_ = self._cm_dev_ + cm
+                self.confusion_matrix.devmem = self._cm_dev_
+            # scalars ride ONE batched device_get (per-leaf reads pay a
+            # full sync RTT each on tunneled/remote devices)
+            n_err, maxerr = jax.device_get((n_err, maxerr))
+            self.n_err.map_write()[0] += int(n_err)
             self.max_err_output_sum.map_write()[0] = max(
                 float(self.max_err_output_sum[0]), float(maxerr))
         else:
-            sse, mx, mn = macc
+            sse, mx, mn = jax.device_get(self._macc_)
             m = self.metrics.map_write()
             m[0] += float(sse)
             m[1] = max(m[1], float(mx))

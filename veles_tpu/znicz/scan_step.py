@@ -55,13 +55,18 @@ class ScanEpochStep(FusedTrainStep):
 
         train = self._train_step_.__wrapped__
         evaluate = self._eval_step_.__wrapped__
-        data_dev = self.loader.original_data.devmem
+        # the resident dataset is an ARGUMENT of the jitted scans, not a
+        # closure capture — a closed-over jax.Array becomes an HLO literal,
+        # bloating the executable by the whole dataset (and overflowing
+        # remote-compile transports on large sets)
+        self._data_dev_ = self.loader.original_data.devmem
         if self.loss_kind == "softmax":
-            y_dev = jax.device_put(self.loader._dense_labels)
+            self._y_dev_ = jax.device_put(self.loader._dense_labels)
         else:
-            y_dev = self.loader.original_targets.devmem
+            self._y_dev_ = self.loader.original_targets.devmem
 
-        def train_scan(params, opt, macc, idx, sizes, seeds):
+        def train_scan(data_dev, y_dev, params, opt, macc, idx, sizes,
+                       seeds):
             def body(carry, batch):
                 p, o, m = carry
                 bidx, bsize, bseed = batch
@@ -73,7 +78,7 @@ class ScanEpochStep(FusedTrainStep):
                 body, (params, opt, macc), (idx, sizes, seeds))
             return params, opt, macc, losses
 
-        def eval_scan(params, macc, idx, sizes):
+        def eval_scan(data_dev, y_dev, params, macc, idx, sizes):
             def body(m, batch):
                 bidx, bsize = batch
                 x = jnp.take(data_dev, bidx, axis=0)
@@ -83,8 +88,8 @@ class ScanEpochStep(FusedTrainStep):
             macc, losses = lax.scan(body, macc, (idx, sizes))
             return macc, losses
 
-        self._train_scan_ = jax.jit(train_scan, donate_argnums=(0, 1, 2))
-        self._eval_scan_ = jax.jit(eval_scan, donate_argnums=(1,))
+        self._train_scan_ = jax.jit(train_scan, donate_argnums=(2, 3, 4))
+        self._eval_scan_ = jax.jit(eval_scan, donate_argnums=(3,))
 
     def _next_seeds(self, n):
         """Deterministic consecutive per-batch seeds (matches the per-step
@@ -132,10 +137,12 @@ class ScanEpochStep(FusedTrainStep):
         idx, sizes = self._class_index_matrix(cls)
         if cls == loader_mod.TRAIN:
             (self._params_, self._opt_, self._macc_, losses) = \
-                self._train_scan_(self._params_, self._opt_, self._macc_,
+                self._train_scan_(self._data_dev_, self._y_dev_,
+                                  self._params_, self._opt_, self._macc_,
                                   idx, sizes, self._next_seeds(len(sizes)))
         else:
             self._macc_, losses = self._eval_scan_(
+                self._data_dev_, self._y_dev_,
                 self._params_, self._macc_, idx, sizes)
         self.loss = losses[-1]
         ld.samples_served += int(sizes.sum())
@@ -175,7 +182,8 @@ class ScanEpochStep(FusedTrainStep):
         idx = numpy.concatenate([c[0] for c in chunks])
         sizes = numpy.concatenate([c[1] for c in chunks])
         (self._params_, self._opt_, self._macc_, losses) = \
-            self._train_scan_(self._params_, self._opt_, self._macc_,
+            self._train_scan_(self._data_dev_, self._y_dev_,
+                              self._params_, self._opt_, self._macc_,
                               idx, sizes, self._next_seeds(len(sizes)))
         self.loss = losses[-1]
         ld.samples_served += int(sizes.sum())

@@ -204,6 +204,11 @@ class StandardWorkflow(Workflow):
                 **self.trainer_config)
             self.fused_step.link_from(self.loader)
             self.fused_step.link_loader(self.loader)
+            from ..loader.fullbatch import FullBatchLoader
+            if isinstance(self.loader, FullBatchLoader):
+                # HBM-resident dataset: gather rides inside the jitted
+                # step — one executable launch per minibatch
+                self.fused_step.link_fused_gather(self.loader)
         self.decision.link_from(self.fused_step)
         self.decision.link_loader(self.loader)
         self.decision.link_evaluator(self.fused_step)
