@@ -1,0 +1,45 @@
+"""Ensemble train/test (VERDICT item: 3 MNIST runs, aggregated)."""
+
+import os
+
+from veles_tpu import ensemble
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_aggregate():
+    out = ensemble.aggregate([
+        {"results": {"err": 5.0, "loss": 0.2}},
+        {"results": {"err": 7.0, "loss": 0.4}},
+        {"rc": 1},  # failed instance contributes nothing
+    ])
+    assert out["err"] == {"mean": 6.0, "std": 1.0, "min": 5.0, "max": 7.0,
+                          "n": 2}
+
+
+def test_ensemble_train_and_vote(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out_file = str(tmp_path / "ensemble.json")
+    snap_dir = str(tmp_path / "snaps")
+    out = ensemble.train(
+        "veles_tpu/znicz/samples/mnist.py", 3, train_ratio=0.8,
+        argv=["root.mnist.loader={'minibatch_size': 100, 'n_train': 600, "
+              "'n_valid': 200}",
+              "root.mnist.decision={'max_epochs': 2, 'silent': True}",
+              "root.mnist.snapshotter={'directory': %r, "
+              "'time_interval': 0}" % snap_dir],
+        out_file=out_file, env=env, silent=True, timeout=300)
+    assert all(e["rc"] == 0 for e in out["instances"]), out
+    summary = out["summary"]
+    assert summary["best_validation_error_pt"]["n"] == 3
+    # each instance snapshotted its best model
+    snaps = [e["results"]["Snapshot"] for e in out["instances"]]
+    assert all(s and os.path.exists(s) for s in snaps), snaps
+    # averaged-probability voting over the restored instances
+    voted = ensemble.test(out_file)
+    assert voted["instances_used"] == 3
+    assert voted["n_valid"] == 200
+    # the ensemble must be at least as good as the worst instance
+    worst = summary["best_validation_error_pt"]["max"]
+    assert voted["validation_error_pt"] <= worst + 1.0, (voted, summary)

@@ -1,0 +1,40 @@
+"""BASELINE gate-model samples: MnistAE (RMSE gate) and Kohonen SOM.
+
+Reference gates (BASELINE.md): MnistAE validation RMSE <= 0.5478
+(/root/reference/docs/source/manualrst_veles_algorithms.rst:69); Kohonen
+demo from BASELINE.json config #5 (the reference publishes no numeric
+gate for it — the assertion is that the map organizes, i.e. the mean
+quantization error drops steeply).
+"""
+
+from veles_tpu.backends import Device
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.znicz.samples import kohonen, mnist_ae
+
+
+def test_mnist_ae_rmse_gate():
+    wf = mnist_ae.create_workflow(
+        loader={"minibatch_size": 100, "n_train": 2000, "n_valid": 500,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 8, "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    wf.run()
+    res = wf.gather_results()
+    # published gate is 0.5478 on real MNIST; the synthetic twin with the
+    # same range_linear normalization trains to well under it
+    assert res["best_validation_rmse"] < 0.5478, res
+
+
+def test_kohonen_som_organizes():
+    wf = kohonen.create_workflow(decision={"max_epochs": 12, "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    wf.run()
+    h = wf.decision.qerror_history
+    assert len(h) == 12
+    assert h[-1] < h[0] * 0.5, (h[0], h[-1])
+    # the trainer's public weights Array reflects the trained codebook
+    w = wf.trainer.weights.map_read()
+    assert w.shape == (64, 2)
+    # results surface through the IResultProvider protocol
+    res = wf.gather_results()
+    assert res["final_quantization_error"] == h[-1]

@@ -25,6 +25,7 @@ import argparse
 import ast
 import importlib
 import importlib.util
+import json
 import os
 import sys
 
@@ -66,11 +67,13 @@ def import_workflow_module(spec):
 
 
 def apply_config_file(path):
-    """Execute a config file with ``root`` in scope (the reference runpy
-    convention, __main__.py:432)."""
+    """Execute a config file with ``root`` (and ``Range``, for GA
+    tuneables) in scope (the reference runpy convention, __main__.py:432;
+    reference configs imported veles.genetics.Range the same way)."""
+    from .config import Range
     with open(path) as f:
         source = f.read()
-    exec(compile(source, path, "exec"), {"root": root})
+    exec(compile(source, path, "exec"), {"root": root, "Range": Range})
 
 
 def parse_mesh(text):
@@ -128,6 +131,17 @@ def make_parser():
                    help="print per-unit timing stats after the run")
     p.add_argument("--no-fix-config", action="store_true",
                    help="keep Range placeholders (genetic optimizer use)")
+    p.add_argument("--optimize", default=None, metavar="SIZE[:GENERATIONS]",
+                   help="GA-optimize the config's Range values by running "
+                        "trials as subprocesses (reference --optimize)")
+    p.add_argument("--fitness-key", default="best_validation_error_pt",
+                   help="result JSON key minimized by --optimize")
+    p.add_argument("--ensemble-train", default=None, metavar="SIZE[:RATIO]",
+                   help="train SIZE instances on random train subsets "
+                        "(reference --ensemble-train size:ratio)")
+    p.add_argument("--ensemble-test", default=None, metavar="FILE.json",
+                   help="averaged-probability inference over the "
+                        "ensemble train output JSON")
     return p
 
 
@@ -203,6 +217,12 @@ class Main:
             # `workflow.py root.x=1` without a config file
             args.overrides.insert(0, args.config)
             args.config = None
+        if args.ensemble_test:
+            # pure aggregation over an existing ensemble JSON — no
+            # workflow module involved
+            from . import ensemble
+            self._write_result(ensemble.test(args.ensemble_test))
+            return 0
         if not args.workflow:
             if args.dump_config:
                 root.print_()
@@ -220,6 +240,8 @@ class Main:
             if not value:
                 raise SystemExit("override %r needs =value" % override)
             set_config_by_path(root, path, _parse_value(value))
+        if args.optimize or args.ensemble_train:
+            return self._run_meta(module)
         if not args.no_fix_config:
             fix_config(root)
         if args.dump_config:
@@ -240,6 +262,75 @@ class Main:
         wf = self.workflow
         if wf is not None and args.dry_run == "exec" and not wf.is_finished:
             return 1  # unit queue drained without reaching the end point
+        return 0
+
+
+    # -- meta modes: GA optimization and ensembles ---------------------------
+    def _trial_argv(self):
+        """CLI arguments each subprocess trial inherits (config file,
+        overrides, backend/mode — NOT the meta flags themselves)."""
+        args = self.args
+        argv = []
+        if args.config:
+            argv.append(args.config)
+        argv += args.overrides
+        if args.backend:
+            argv += ["--backend", args.backend]
+        if args.mode:
+            argv += ["--mode", args.mode]
+        if args.mesh:
+            argv += ["--mesh", ",".join("%s=%d" % kv
+                                        for kv in args.mesh.items())]
+        if args.model_axis:
+            argv += ["--model-axis", args.model_axis]
+        if args.snapshot:
+            argv += ["--snapshot", args.snapshot]
+        for assignment in args.sets:
+            argv += ["--set", assignment]
+        if args.random_seed is not None:
+            argv += ["--random-seed", str(args.random_seed)]
+        return argv
+
+    def _write_result(self, payload):
+        args = self.args
+        text = json.dumps(payload, indent=2)
+        if args.result_file and args.result_file != "-":
+            with open(args.result_file, "w") as f:
+                f.write(text)
+        else:
+            print(text)
+
+    def _run_meta(self, module):
+        """Dispatch --optimize / --ensemble-train (--ensemble-test is
+        handled earlier in run(): it needs no workflow module).  The
+        reference ran these same meta-workflows by re-invoking its own
+        CLI per trial (optimization_workflow.py:286-296,
+        ensemble/base_workflow.py:134-141)."""
+        args = self.args
+        if args.ensemble_train:
+            from . import ensemble
+            size, _, ratio = args.ensemble_train.partition(":")
+            out = ensemble.train(
+                args.workflow, int(size),
+                train_ratio=float(ratio) if ratio else 1.0,
+                argv=self._trial_argv(),
+                out_file=(args.result_file
+                          if args.result_file not in (None, "-") else None))
+            if args.result_file in (None, "-"):
+                self._write_result(out["summary"])
+            return 0
+        from .genetics import GeneticsOptimizer
+        size, _, gens = args.optimize.partition(":")
+        trial_argv = self._trial_argv()
+        if args.random_seed is None:
+            # trials must still be deterministic relative to each other
+            trial_argv += ["--random-seed", "1234"]
+        opt = GeneticsOptimizer(
+            model=args.workflow, config=root, size=int(size),
+            generations=int(gens) if gens else 2,
+            fitness_key=args.fitness_key, argv=trial_argv)
+        best = opt.run()
+        self._write_result(best)
         return 0
 
 

@@ -146,6 +146,19 @@ class Config:
                                     ", ".join(self.keys()) or "(empty)")
 
 
+def _fix_container(obj):
+    """Collapse Ranges inside plain dict/list containers (layer configs
+    are dicts in a list — the reference's process_config walked them too,
+    genetics/config.py)."""
+    if isinstance(obj, Range):
+        return obj.value
+    if isinstance(obj, dict):
+        return {k: _fix_container(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_fix_container(v) for v in obj]
+    return obj
+
+
 def fix_config(cfg):
     """Collapse every Range in the tree to its plain default value."""
     for key, value in list(cfg.__dict__.items()):
@@ -153,12 +166,24 @@ def fix_config(cfg):
             continue
         if isinstance(value, Config):
             fix_config(value)
-        elif isinstance(value, Range):
-            cfg.__dict__[key] = value.value
+        elif isinstance(value, (Range, dict, list)):
+            cfg.__dict__[key] = _fix_container(value)
+
+
+def _ranges_in_container(obj, prefix, out):
+    if isinstance(obj, Range):
+        out.append((prefix, obj))
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _ranges_in_container(v, "%s.%s" % (prefix, k), out)
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            _ranges_in_container(v, "%s.%d" % (prefix, i), out)
 
 
 def get_config_ranges(cfg, prefix=None, out=None):
-    """Collect (path, Range) pairs for the genetic optimizer."""
+    """Collect (path, Range) pairs for the genetic optimizer, including
+    Ranges nested in dict/list values (layer config lists)."""
     if out is None:
         out = []
     prefix = prefix if prefix is not None else cfg.path
@@ -167,20 +192,41 @@ def get_config_ranges(cfg, prefix=None, out=None):
             continue
         if isinstance(value, Config):
             get_config_ranges(value, "%s.%s" % (prefix, key), out)
-        elif isinstance(value, Range):
-            out.append(("%s.%s" % (prefix, key), value))
+        else:
+            _ranges_in_container(value, "%s.%s" % (prefix, key), out)
     return out
 
 
 def set_config_by_path(cfg, dotted, value):
-    """Assign ``root.a.b.c = value`` given the dotted path string."""
+    """Assign ``root.a.b.c = value`` given the dotted path string.
+    Numeric segments index into lists; dict keys are traversed too, so
+    GA paths like ``root.mnist.layers.0.<-.learning_rate`` resolve."""
     parts = dotted.split(".")
     if parts and parts[0] == "root":
         parts = parts[1:]
     node = cfg
     for p in parts[:-1]:
-        node = getattr(node, p)
-    setattr(node, parts[-1], value)
+        if isinstance(node, list):
+            node = node[int(p)]
+        elif isinstance(node, dict):
+            node = node[p]
+        else:
+            node = getattr(node, p)
+    last = parts[-1]
+    if isinstance(node, list):
+        node[int(last)] = value
+    elif isinstance(node, dict):
+        node[last] = value
+    elif isinstance(value, dict):
+        # dict override merges as a Config subtree (so CLI overrides like
+        # root.x.snapshotter={...} behave like config-file declarations)
+        child = getattr(node, last)
+        if isinstance(child, Config):
+            child.update(value)
+        else:
+            setattr(node, last, value)
+    else:
+        setattr(node, last, value)
 
 
 #: The global configuration tree (reference: veles/config.py:152).

@@ -1,0 +1,135 @@
+"""Ensemble training/testing (reference veles/ensemble/).
+
+The reference's ``--ensemble-train size:ratio`` trained N model instances
+as subprocesses of ``veles.__main__``, each on a random train subset,
+collecting one results JSON per instance
+(/root/reference/veles/ensemble/base_workflow.py:59-141, model_workflow.py
+:50-137); test mode aggregated the instances' outputs.
+
+TPU-native equivalent: each instance is a subprocess of our CLI with a
+distinct ``--random-seed`` (so the loader's shuffle — and therefore the
+``train_ratio`` subset — differs per instance) and
+``root.common.ensemble.train_ratio`` applied by the Loader base.  Train
+results (including each instance's best snapshot path when a snapshotter
+runs) land in one ensemble JSON; :func:`test` restores every instance's
+snapshot and averages the softmax outputs over the validation set —
+probability-averaging ensemble inference on device.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy
+
+
+def train(model, size, train_ratio=1.0, argv=(), out_file=None,
+          base_seed=1000, python=None, timeout=None, silent=False,
+          env=None):
+    """Train ``size`` instances, return the aggregated results dict."""
+    python = python or sys.executable
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    instances = []
+    for i in range(size):
+        fd, result_file = tempfile.mkstemp(
+            prefix="veles-tpu-ensemble-%d-" % i, suffix=".json")
+        os.close(fd)
+        try:
+            cmd = ([python, "-m", "veles_tpu", model] + list(argv) +
+                   ["root.common.ensemble.train_ratio=%r" % train_ratio,
+                    "--random-seed", str(base_seed + i),
+                    "--result-file", result_file])
+            proc = subprocess.run(cmd, timeout=timeout,
+                                  capture_output=True, cwd=repo, env=env)
+            entry = {"instance": i, "seed": base_seed + i,
+                     "rc": proc.returncode}
+            if proc.returncode == 0 and os.path.getsize(result_file):
+                with open(result_file) as f:
+                    entry["results"] = json.load(f)
+            else:
+                entry["error"] = proc.stderr.decode()[-2000:]
+            instances.append(entry)
+        finally:
+            os.unlink(result_file)
+        if not silent:
+            print("ensemble instance %d/%d: rc=%d %s" % (
+                i + 1, size, proc.returncode,
+                entry.get("results", entry.get("error", ""))))
+    summary = aggregate(instances)
+    out = {"model": model, "size": size, "train_ratio": train_ratio,
+           "instances": instances, "summary": summary}
+    if out_file:
+        with open(out_file, "w") as f:
+            json.dump(out, f, indent=2)
+    return out
+
+
+def aggregate(instances):
+    """Summarize per-instance metrics: mean/std/best of every numeric."""
+    keys = {}
+    for entry in instances:
+        for k, v in entry.get("results", {}).items():
+            if isinstance(v, (int, float)) and v is not None:
+                keys.setdefault(k, []).append(float(v))
+    return {k: {"mean": float(numpy.mean(v)), "std": float(numpy.std(v)),
+                "min": float(numpy.min(v)), "max": float(numpy.max(v)),
+                "n": len(v)}
+            for k, v in keys.items()}
+
+
+def test(ensemble_file_or_dict, device=None):
+    """Averaged-probability ensemble inference over the validation set.
+
+    Restores every instance's best snapshot (``Snapshot`` result key),
+    runs the forward chain on its loader's validation samples, averages
+    the class probabilities across instances, and reports the voted
+    error rate (reference ensemble/test_workflow.py role)."""
+    import jax
+    import jax.numpy as jnp
+    from ..loader.base import VALID
+    from ..snapshotter import restore
+    from ..backends import Device
+
+    if isinstance(ensemble_file_or_dict, str):
+        with open(ensemble_file_or_dict) as f:
+            ensemble = json.load(f)
+    else:
+        ensemble = ensemble_file_or_dict
+    device = device or Device(backend="auto")
+    probs_sum = None
+    labels = None
+    used = 0
+    for entry in ensemble["instances"]:
+        snap = entry.get("results", {}).get("Snapshot")
+        if not snap or not os.path.exists(snap):
+            continue
+        wf = restore(snap)
+        wf.initialize(device=device)
+        ld = wf.loader
+        start = ld.class_end_offsets[VALID] - ld.class_lengths[VALID]
+        end = ld.class_end_offsets[VALID]
+        data = numpy.asarray(ld.original_data.map_read()[start:end])
+        data = data.reshape(len(data), -1) if data.ndim == 2 or \
+            wf.forwards[0].MAPPING.startswith("all2all") else data
+        if labels is None:
+            labels = numpy.asarray(ld._dense_labels[start:end])
+        params = [f.params for f in wf.forwards]
+
+        def forward(params, x, forwards=wf.forwards):
+            h = x
+            for i, f in enumerate(forwards):
+                h = f.apply(params[i], h)
+            return h
+        out = jax.jit(forward)(params, jnp.asarray(data))
+        p = jax.nn.softmax(out) if out.shape[-1] > 1 else out
+        probs_sum = p if probs_sum is None else probs_sum + p
+        used += 1
+    if not used:
+        raise ValueError("no instance has a restorable Snapshot result")
+    pred = numpy.asarray(jnp.argmax(probs_sum, axis=-1))
+    err_pt = 100.0 * float((pred != labels).mean())
+    return {"instances_used": used, "validation_error_pt": err_pt,
+            "n_valid": int(len(labels))}

@@ -1,0 +1,14 @@
+"""Genetic hyperparameter optimization (reference veles/genetics/).
+
+``Range`` placeholders in the config tree (veles_tpu.config.Range) mark
+tuneable values; the optimizer evolves a population of chromosomes over
+them, evaluating each by running the model — in-process via a callable,
+or as a subprocess of the CLI exactly like the reference re-invoked
+``veles.__main__`` per trial (reference optimization_workflow.py:223-296).
+"""
+
+from .core import Chromosome, Population, schwefel
+from .optimizer import GeneticsOptimizer, optimize
+
+__all__ = ["Chromosome", "Population", "schwefel", "GeneticsOptimizer",
+           "optimize"]

@@ -1,0 +1,315 @@
+"""GA core: chromosomes, mutation, selection, crossover, population loop.
+
+Re-creation of /root/reference/veles/genetics/core.py (:257-760) with the
+same operator families:
+
+- mutation: altering (gene swap), gaussian (bounded additive noise),
+  uniform (bounded resample) — core.py:277-370;
+- selection: roulette, random, tournament — core.py:573-617;
+- crossover: pointed (k-point), uniform, arithmetic, geometric —
+  core.py:633-760, driven as a probability-weighted pipeline.
+
+Dropped deliberately: the reference's binary/gray *bitstring* coding of
+floats (core.py Chromosome.binary) — an artifact of its OpenCL bit-level
+mutation kernels; numeric coding covers the same search capability and
+is what the reference itself defaults to (optimization.code "float").
+Choice-typed genes (``Range(v, [choices])``) mutate by resampling the
+choice list, mirroring the reference's ``choice == "or"`` mode.
+"""
+
+import numpy
+
+
+def schwefel(values):
+    """Schwefel test function (reference core.py:58): global max 0 at
+    x_i = 420.9687, used by the self-tests."""
+    values = numpy.asarray(values, numpy.float64)
+    return -418.9829 * len(values) + float(
+        (values * numpy.sin(numpy.sqrt(numpy.abs(values)))).sum())
+
+
+class Chromosome:
+    """Numeric-coded chromosome: one gene per tuneable."""
+
+    def __init__(self, genes, min_values, max_values, rand, choices=None):
+        self.genes = list(genes)
+        self.min_values = list(min_values)
+        self.max_values = list(max_values)
+        self.choices = choices or [None] * len(self.genes)
+        self.rand = rand
+        self.fitness = None
+        self.config_snapshot = None      # filled by the optimizer
+
+    @classmethod
+    def random(cls, min_values, max_values, rand, choices=None):
+        choices = choices or [None] * len(min_values)
+        genes = []
+        for lo, hi, ch in zip(min_values, max_values, choices):
+            if ch is not None:
+                genes.append(ch[rand.randint(0, len(ch))])
+            else:
+                g = rand.uniform(lo, hi)
+                if isinstance(lo, int) and isinstance(hi, int):
+                    g = int(round(g))
+                genes.append(g)
+        return cls(genes, min_values, max_values, rand, choices)
+
+    def copy(self):
+        c = Chromosome(self.genes, self.min_values, self.max_values,
+                       self.rand, self.choices)
+        c.fitness = self.fitness
+        return c
+
+    def _clip(self, i, value):
+        lo, hi = self.min_values[i], self.max_values[i]
+        # reflect back into range (reference wraps by +/- diff)
+        diff = hi - lo
+        if diff <= 0:
+            return lo  # degenerate Range(v) with no bounds: pinned
+        while value < lo or value > hi:
+            value = value + diff if value < lo else value - diff
+        if isinstance(lo, int) and isinstance(hi, int):
+            value = int(round(value))
+        return value
+
+    # -- mutation ops (reference core.py:277-370) ---------------------------
+    def mutation_altering(self, n_points, probability):
+        """Swap two gene positions."""
+        for _ in range(n_points):
+            if self.rand.uniform(0, 1) < probability and len(self.genes) > 1:
+                i = self.rand.randint(0, len(self.genes))
+                j = self.rand.randint(0, len(self.genes))
+                if self.choices[i] is None and self.choices[j] is None:
+                    self.genes[i], self.genes[j] = (self.genes[j],
+                                                    self.genes[i])
+                    self.fitness = None
+
+    def mutation_gaussian(self, n_points, probability):
+        """Add bounded gaussian noise to up to n_points genes."""
+        pool = list(range(len(self.genes)))
+        for _ in range(min(n_points, len(pool))):
+            i = pool.pop(self.rand.randint(0, len(pool)))
+            if self.rand.uniform(0, 1) >= probability:
+                continue
+            self.fitness = None
+            if self.choices[i] is not None:
+                ch = self.choices[i]
+                self.genes[i] = ch[self.rand.randint(0, len(ch))]
+                continue
+            lo, hi = self.min_values[i], self.max_values[i]
+            diff = hi - lo
+            noise = self.rand.normal(0.0, numpy.sqrt(max(diff, 1e-12) / 6))
+            sign = 1.0 if self.rand.uniform(0, 1) < 0.5 else -1.0
+            self.genes[i] = self._clip(i, self.genes[i] + sign * noise)
+
+    def mutation_uniform(self, n_points, probability):
+        """Resample up to n_points genes uniformly in range."""
+        pool = list(range(len(self.genes)))
+        for _ in range(min(n_points, len(pool))):
+            i = pool.pop(self.rand.randint(0, len(pool)))
+            if self.rand.uniform(0, 1) >= probability:
+                continue
+            self.fitness = None
+            if self.choices[i] is not None:
+                ch = self.choices[i]
+                self.genes[i] = ch[self.rand.randint(0, len(ch))]
+                continue
+            lo, hi = self.min_values[i], self.max_values[i]
+            self.genes[i] = self._clip(i, self.rand.uniform(lo, hi))
+
+    def mutate(self, name, n_points=1, probability=0.4):
+        getattr(self, "mutation_" + name)(n_points, probability)
+
+
+class Population:
+    """Fixed-size population with the reference's evolve cycle:
+    select parents → crossover pipeline adds offspring → mutate →
+    evaluate → sort by fitness → truncate (reference core.py:573-880)."""
+
+    #: hard backstop against unbounded evolution (reference core.py
+    #: MAX_GENERATIONS)
+    MAX_GENERATIONS = 1000
+
+    def __init__(self, min_values, max_values, size, rand, choices=None,
+                 max_generations=None, patience=3, crossing_attempts=10):
+        self.patience = patience
+        self._stale_generations = 0
+        assert len(min_values) == len(max_values)
+        self.min_values = list(min_values)
+        self.max_values = list(max_values)
+        self.choices = choices or [None] * len(min_values)
+        self.size = int(size)
+        self.rand = rand
+        self.max_generations = max_generations
+        self.crossing_attempts = crossing_attempts
+        self.generation = 0
+        self.best_fit = None
+        self.average_fit = None
+        self.improved = True
+        # reference crossing pipeline shares (core.py:612-632)
+        self.roulette_select_size = 0.75
+        self.crossings = (("uniform", 0.15, 0.9),
+                          ("arithmetic", 0.15, 0.9),
+                          ("geometric", 0.2, 0.9),
+                          ("pointed", 0.2, 1.0))
+        self.mutations = (("gaussian", 1, 0.35),
+                          ("uniform", 1, 0.35),
+                          ("altering", 1, 0.1))
+        self.chromosomes = [
+            Chromosome.random(self.min_values, self.max_values, rand,
+                              self.choices)
+            for _ in range(self.size)]
+
+    def __len__(self):
+        return len(self.chromosomes)
+
+    def __iter__(self):
+        return iter(self.chromosomes)
+
+    def __getitem__(self, i):
+        return self.chromosomes[i]
+
+    # -- selection (reference core.py:573-617) ------------------------------
+    def select_roulette(self, count=None):
+        count = count or int(len(self) * self.roulette_select_size)
+        fits = numpy.array([c.fitness for c in self.chromosomes],
+                           numpy.float64)
+        # failed evaluations (-inf) get zero weight; the finite worst
+        # keeps a sliver so diversity survives
+        finite = numpy.isfinite(fits)
+        if not finite.any():
+            fits = numpy.ones(len(fits))
+        else:
+            lo = fits[finite].min()
+            span = fits[finite].max() - lo
+            fits = numpy.where(finite, fits - lo + max(span, 1.0) * 1e-3,
+                               0.0)
+        probs = numpy.cumsum(fits / fits.sum())
+        out = []
+        for _ in range(count):
+            r = self.rand.uniform(0, 1)
+            out.append(self.chromosomes[int(numpy.searchsorted(probs, r))])
+        return out
+
+    def select_random(self, count=None):
+        count = count or len(self) // 2
+        return [self.chromosomes[self.rand.randint(0, len(self))]
+                for _ in range(count)]
+
+    def select_tournament(self, count=None, pool_ratio=0.5):
+        count = count or max(2, len(self) // 10)
+        pool = sorted(
+            (self.chromosomes[self.rand.randint(0, len(self))]
+             for _ in range(int(len(self) * pool_ratio))),
+            key=lambda c: -(c.fitness or -numpy.inf))
+        return pool[:count]
+
+    # -- crossover ops (reference core.py:633-760) --------------------------
+    def _parents(self, parents):
+        a = parents[self.rand.randint(0, len(parents))]
+        b = parents[self.rand.randint(0, len(parents))]
+        return a, b
+
+    def cross_pointed(self, parents, n_points=1):
+        a, b = self._parents(parents)
+        cut = sorted(self.rand.randint(0, len(a.genes) + 1)
+                     for _ in range(n_points))
+        genes1, genes2 = list(a.genes), list(b.genes)
+        flip = False
+        prev = 0
+        for c in cut + [len(a.genes)]:
+            if flip:
+                genes1[prev:c], genes2[prev:c] = (genes2[prev:c],
+                                                  genes1[prev:c])
+            flip = not flip
+            prev = c
+        return [Chromosome(genes1, self.min_values, self.max_values,
+                           self.rand, self.choices)]
+
+    def cross_uniform(self, parents, probability=0.9):
+        a, b = self._parents(parents)
+        genes = [ga if self.rand.uniform(0, 1) < 0.5 else gb
+                 for ga, gb in zip(a.genes, b.genes)]
+        return [Chromosome(genes, self.min_values, self.max_values,
+                           self.rand, self.choices)]
+
+    def cross_arithmetic(self, parents, probability=0.9):
+        a, b = self._parents(parents)
+        genes = []
+        for i, (ga, gb) in enumerate(zip(a.genes, b.genes)):
+            if self.choices[i] is not None:
+                genes.append(ga if self.rand.uniform(0, 1) < 0.5 else gb)
+                continue
+            k = self.rand.uniform(0, 1)
+            g = k * ga + (1 - k) * gb
+            if isinstance(self.min_values[i], int) and \
+                    isinstance(self.max_values[i], int):
+                g = int(round(g))
+            genes.append(g)
+        return [Chromosome(genes, self.min_values, self.max_values,
+                           self.rand, self.choices)]
+
+    def cross_geometric(self, parents, probability=0.9):
+        a, b = self._parents(parents)
+        genes = []
+        for i, (ga, gb) in enumerate(zip(a.genes, b.genes)):
+            if self.choices[i] is not None:
+                genes.append(ga if self.rand.uniform(0, 1) < 0.5 else gb)
+                continue
+            lo = self.min_values[i]
+            # geometric mean in the shifted-positive domain
+            sa, sb = ga - lo + 1e-9, gb - lo + 1e-9
+            g = lo + float(numpy.sqrt(sa * sb)) - 1e-9
+            if isinstance(lo, int) and isinstance(self.max_values[i], int):
+                g = int(round(g))
+            genes.append(g)
+        return [Chromosome(genes, self.min_values, self.max_values,
+                           self.rand, self.choices)]
+
+    # -- evolve -------------------------------------------------------------
+    def evolve(self, evaluate):
+        """One generation: returns True while the population keeps
+        improving and max_generations is not exhausted."""
+        for c in self.chromosomes:
+            if c.fitness is None:
+                c.fitness = evaluate(c)
+        prev_best = self.best_fit
+        parents = self.select_roulette()
+        offspring = []
+        for name, share, prob in self.crossings:
+            op = getattr(self, "cross_" + name)
+            for _ in range(max(1, int(len(self) * share))):
+                if self.rand.uniform(0, 1) < prob:
+                    offspring.extend(op(parents))
+        for child in offspring:
+            name, pts, prob = self.mutations[
+                self.rand.randint(0, len(self.mutations))]
+            child.mutate(name, pts, prob)
+        for c in offspring:
+            c.fitness = evaluate(c)
+        pool = self.chromosomes + offspring
+        pool.sort(key=lambda c: -c.fitness)
+        self.chromosomes = pool[:self.size]
+        self.best_fit = self.chromosomes[0].fitness
+        self.average_fit = float(numpy.mean(
+            [c.fitness for c in self.chromosomes]))
+        self.generation += 1
+        self.improved = prev_best is None or self.best_fit > prev_best
+        self._stale_generations = 0 if self.improved else \
+            self._stale_generations + 1
+        if self.max_generations is not None and \
+                self.generation >= self.max_generations:
+            return False
+        if self.generation >= self.MAX_GENERATIONS:
+            return False
+        # no explicit generation budget: run until the population stops
+        # improving for `patience` generations (the reference stopped on
+        # ~population.improved the same way)
+        if self.max_generations is None and \
+                self._stale_generations >= self.patience:
+            return False
+        return True
+
+    @property
+    def best(self):
+        return self.chromosomes[0]

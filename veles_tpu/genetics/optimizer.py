@@ -1,0 +1,166 @@
+"""GeneticsOptimizer: evolve Range-marked config values over model runs.
+
+Re-creation of /root/reference/veles/genetics/optimization_workflow.py
+(:70-296).  The reference evaluated each chromosome by re-invoking
+``veles.__main__`` as a subprocess with a patched pickled config; here
+each trial is a subprocess of *our* CLI (``python -m veles_tpu``) with
+plain ``root.x.y=value`` overrides — same isolation (fresh process, fresh
+jit cache, fresh devices), simpler plumbing.  An in-process ``evaluator``
+callable is supported for tests and for cheap objectives.
+
+Fitness: the reference looked up ``EvaluationFitness`` in the result
+JSON; we read ``fitness_key`` (default ``best_validation_error_pt``) and
+negate it when ``minimize`` (default) so the GA always maximizes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+from ..config import root, get_config_ranges
+from ..prng import RandomGenerator
+from .core import Population
+
+
+class GeneticsOptimizer:
+    """Drives a Population over the Range placeholders of a config tree.
+
+    Parameters
+    ----------
+    model: workflow file path or module name (subprocess mode), or None
+        when ``evaluator`` is given.
+    config: the Config node to scan for Range placeholders (e.g.
+        ``root.mnist``); default: the whole root.
+    evaluator: optional callable({path: value}) -> float fitness
+        (maximized).  When absent, trials run as CLI subprocesses.
+    size: population size.  generations: max generations.
+    fitness_key / minimize: how to read the result JSON (subprocess mode).
+    argv: extra CLI arguments for every trial (config file, overrides,
+        ``--backend`` etc.).
+    """
+
+    def __init__(self, model=None, config=None, evaluator=None, size=10,
+                 generations=None, fitness_key="best_validation_error_pt",
+                 minimize=True, argv=(), rand=None, python=None,
+                 timeout=None, silent=False, env=None):
+        self.env = env
+        self.model = model
+        self.config_node = config if config is not None else root
+        self.evaluator = evaluator
+        self.fitness_key = fitness_key
+        self.minimize = minimize
+        self.argv = list(argv)
+        self.python = python or sys.executable
+        self.timeout = timeout
+        self.silent = silent
+        self.tuneables = get_config_ranges(self.config_node)
+        if not self.tuneables:
+            raise ValueError(
+                "no tuneable parameters: wrap at least one config value "
+                "in veles_tpu.config.Range (reference "
+                "optimization_workflow.py:82-86)")
+        mins, maxs, choices = [], [], []
+        for _path, rng in self.tuneables:
+            if rng.choices is not None:
+                mins.append(0)
+                maxs.append(len(rng.choices) - 1)
+                choices.append(list(rng.choices))
+            else:
+                mins.append(rng.min_value)
+                maxs.append(rng.max_value)
+                choices.append(None)
+        self.population = Population(
+            mins, maxs, size, rand or RandomGenerator().seed(8),
+            choices=choices, max_generations=generations)
+        self.trials = 0
+        self.failures = 0
+        self._last_failure = None
+
+    # -- evaluation ----------------------------------------------------------
+    def overrides_for(self, chromo):
+        return {path: gene
+                for (path, _rng), gene in zip(self.tuneables, chromo.genes)}
+
+    def _evaluate(self, chromo):
+        assignments = self.overrides_for(chromo)
+        self.trials += 1
+        if self.evaluator is not None:
+            fitness = float(self.evaluator(assignments))
+        else:
+            fitness = self._evaluate_subprocess(assignments)
+        chromo.config_snapshot = assignments
+        if not self.silent:
+            print("trial %d: %s -> fitness %.6f" %
+                  (self.trials, assignments, fitness))
+        return fitness
+
+    def _evaluate_subprocess(self, assignments):
+        fd, result_file = tempfile.mkstemp(prefix="veles-tpu-ga-",
+                                           suffix=".json")
+        os.close(fd)
+        try:
+            argv = ([self.python, "-m", "veles_tpu", self.model] +
+                    self.argv +
+                    ["%s=%r" % (path, value)
+                     for path, value in assignments.items()] +
+                    ["--result-file", result_file])
+            proc = subprocess.run(
+                argv, timeout=self.timeout, capture_output=True,
+                env=self.env,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))))
+            if proc.returncode:
+                # failed trial = worst possible fitness (the reference
+                # raised EvaluationError and dropped the chromosome)
+                return self._trial_failed(
+                    "exit %d: %s" % (proc.returncode,
+                                     proc.stderr.decode()[-1500:]))
+            with open(result_file) as f:
+                result = json.load(f)
+            value = float(result[self.fitness_key])
+            return -value if self.minimize else value
+        except subprocess.TimeoutExpired:
+            return self._trial_failed("timeout after %ss" % self.timeout)
+        except (KeyError, ValueError, json.JSONDecodeError) as e:
+            return self._trial_failed("bad result JSON: %r" % e)
+        finally:
+            os.unlink(result_file)
+
+    def _trial_failed(self, reason):
+        self.failures += 1
+        self._last_failure = reason
+        if not self.silent:
+            print("trial FAILED: %s" % reason, file=sys.stderr)
+        return -float("inf")
+
+    # -- driving -------------------------------------------------------------
+    def run(self):
+        """Evolve until max_generations (or, when None, until the
+        population stops improving — Population.patience)."""
+        while self.population.evolve(self._evaluate):
+            if not self.silent:
+                print("generation %d: best %.6f avg %.6f" % (
+                    self.population.generation, self.population.best_fit,
+                    self.population.average_fit))
+        if self.population.best_fit == -float("inf"):
+            # total failure must not masquerade as an optimization result
+            # (the reference raised EvaluationError per failed chromosome)
+            raise RuntimeError(
+                "all %d trials failed; last failure: %s" %
+                (self.trials, self._last_failure))
+        return self.best
+
+    @property
+    def best(self):
+        b = self.population.best
+        return {"fitness": b.fitness,
+                "assignments": self.overrides_for(b),
+                "generations": self.population.generation,
+                "trials": self.trials}
+
+
+def optimize(model=None, **kwargs):
+    """One-call API: build the optimizer, run it, return the best."""
+    return GeneticsOptimizer(model, **kwargs).run()

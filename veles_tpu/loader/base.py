@@ -82,7 +82,13 @@ class Loader(Unit, IResultProvider):
         self.normalizer = normalization.factory(
             kwargs.get("normalization_type", "none"),
             **kwargs.get("normalization_parameters", {}))
-        self.train_ratio = kwargs.get("train_ratio", 1.0)
+        # ensemble training subsets: the CLI's model-independent override
+        # (root.common.ensemble.train_ratio) mirrors the reference's
+        # --ensemble-train size:ratio flag; per-loader kwarg wins
+        from ..config import root
+        self.train_ratio = float(kwargs.get(
+            "train_ratio",
+            root.common.ensemble.get("train_ratio", 1.0) or 1.0))
         self.has_labels = True
         self.labels_mapping = {}
         self.raw_minibatch_labels = []

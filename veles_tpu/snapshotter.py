@@ -24,6 +24,7 @@ import time
 from .config import root
 from .mutable import Bool
 from .registry import MappedObjectsRegistry, UnitRegistry
+from .result_provider import IResultProvider
 from .units import Unit
 
 CODECS = {
@@ -46,7 +47,7 @@ class SnapshotterRegistry(UnitRegistry, MappedObjectsRegistry):
     """Units that are also a string-keyed family ("file", "db", ...)."""
 
 
-class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
+class SnapshotterBase(Unit, IResultProvider, metaclass=SnapshotterRegistry):
     """Base: throttling + gate protocol (runs when Decision.improved)."""
 
     mapping = "snapshotter"
@@ -125,6 +126,12 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
 
     def export(self):
         raise NotImplementedError
+
+    def get_metric_values(self):
+        """Surface the last snapshot path in the results JSON (reference
+        optimization_workflow.py:249 reads result.get("Snapshot"); the
+        ensemble test mode restores instances from it)."""
+        return {"Snapshot": self.destination}
 
 
 class SnapshotterToFile(SnapshotterBase):

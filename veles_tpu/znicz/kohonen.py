@@ -1,0 +1,238 @@
+"""Kohonen self-organizing map units (no-gradient trainer path).
+
+Re-creation of the Znicz Kohonen family (absent submodule; model status
+/root/reference/docs/source/manualrst_veles_algorithms.rst:71-85, unit
+kwargs registry manualrst_veles_units_kwargs.jrst:73-78).  The reference
+shipped OpenCL/numpy kernels for the winner search and the neighborhood
+update; here both collapse into one jitted ``lax.scan`` over the
+minibatch:
+
+- winner search: ``argmin ||x - w||²`` computed as ``||w||² - 2·x@wᵀ``
+  (one MXU matmul per sample batch instead of an O(N·F) distance kernel);
+- neighborhood update: Gaussian over the 2-D grid coordinates,
+  ``w += lr · exp(-d²/2σ²) · (x - w)`` — classic *online* SOM semantics
+  (sample-sequential within the batch via ``lax.scan``), deterministic
+  given the loader's shuffle order.
+
+Learning rate and radius decay per epoch:  ``v = v0 · (vf/v0)^(t/T)``.
+"""
+
+import numpy
+
+from ..memory import Array
+from ..result_provider import IResultProvider
+from ..units import Unit
+from .. import loader as loader_mod
+
+
+class KohonenBase(Unit):
+    """Shared codebook holder: weights [rows*cols, n_input] on device."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.shape = tuple(kwargs.get("shape", (8, 8)))
+        self.weights = Array()
+        self.minibatch_data = None       # linked from loader
+        self.minibatch_size = None
+
+    @property
+    def neurons_number(self):
+        return int(numpy.prod(self.shape))
+
+    def link_loader(self, loader):
+        self.link_attrs(loader, "minibatch_data", "minibatch_size")
+        return self
+
+
+class KohonenForward(KohonenBase):
+    """Winner lookup: maps each sample to its best-matching unit index.
+
+    ``output`` holds the winner grid indices (flat) for the last served
+    minibatch; ``distances`` the corresponding squared distances
+    (quantization error per sample)."""
+
+    MAPPING = "kohonen_forward"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.output = Array()
+        self.distances = Array()
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(**kwargs)
+        self.device = device
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def winners(w, x):
+            # ||x-w||² = ||x||² - 2 x·w + ||w||²; ||x||² is constant in
+            # the argmin, so one matmul + row norms suffice
+            scores = (w * w).sum(axis=1)[None, :] - 2.0 * (x @ w.T)
+            win = jnp.argmin(scores, axis=1)
+            d = jnp.take_along_axis(scores, win[:, None], axis=1)[:, 0]
+            d = d + (x * x).sum(axis=1)     # true squared distance
+            return win.astype(jnp.int32), d
+        self._winners_ = winners
+
+    def run(self):
+        win, d = self._winners_(self.weights.devmem,
+                                self.minibatch_data.devmem)
+        self.output.devmem = win
+        self.distances.devmem = d
+
+
+class KohonenTrainer(KohonenBase, IResultProvider):
+    """Online SOM trainer: one jitted scan over the minibatch per run.
+
+    kwargs: ``shape`` (grid rows, cols), ``sigma``/``sigma_final``
+    (neighborhood radius schedule, defaults max(shape)/2 → 0.5),
+    ``learning_rate``/``learning_rate_final`` (0.5 → 0.01), ``epochs``
+    (schedule horizon, default decision's max_epochs), ``weights_stddev``.
+    """
+
+    MAPPING = "kohonen_trainer"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "TRAINER"
+        self.sigma = float(kwargs.get("sigma", max(self.shape) / 2.0))
+        self.sigma_final = float(kwargs.get("sigma_final", 0.5))
+        self.learning_rate = float(kwargs.get("learning_rate", 0.5))
+        self.learning_rate_final = float(
+            kwargs.get("learning_rate_final", 0.01))
+        self.epochs = int(kwargs.get("epochs", 50))
+        self.weights_stddev = float(kwargs.get("weights_stddev", 0.05))
+        self.prng = kwargs.get("prng")
+        self.epoch_number = None         # linked from loader
+        self.last_minibatch = None
+        self.minibatch_class = None
+        # quantization error accumulator (device; flushed per epoch)
+        self.qerror = Array(numpy.zeros(1, numpy.float64))
+        self._epoch_samples = 0
+
+    def link_loader(self, loader):
+        super().link_loader(loader)
+        self.link_attrs(loader, "epoch_number", "last_minibatch",
+                        "minibatch_class")
+        return self
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(**kwargs)
+        self.device = device
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from ..prng import RandomGenerator
+
+        n_input = int(numpy.prod(self.minibatch_data.shape[1:]))
+        n = self.neurons_number
+        if not self.weights:
+            prng = self.prng or RandomGenerator().seed(1)
+            self.weights.mem = prng.normal(
+                0.0, self.weights_stddev, (n, n_input)).astype(numpy.float32)
+        rows, cols = self.shape
+        gy, gx = numpy.mgrid[0:rows, 0:cols]
+        grid = numpy.stack([gy.ravel(), gx.ravel()], 1).astype(numpy.float32)
+        grid_dev = jax.device_put(grid)
+
+        def sample_update(w, x, lr, sigma):
+            scores = (w * w).sum(axis=1) - 2.0 * (w @ x)
+            win = jnp.argmin(scores)
+            qe = scores[win] + (x * x).sum()
+            dg = ((grid_dev - grid_dev[win]) ** 2).sum(axis=1)
+            neigh = jnp.exp(-dg / (2.0 * sigma * sigma))
+            w = w + lr * neigh[:, None] * (x[None, :] - w)
+            return w, qe
+
+        def train_batch(w, qacc, xb, size, lr, sigma):
+            mask = jnp.arange(xb.shape[0]) < size
+
+            def body(carry, inp):
+                w, qacc = carry
+                x, valid = inp
+                w2, qe = sample_update(w, x, lr, sigma)
+                w = jnp.where(valid, w2, w)
+                qacc = qacc + jnp.where(valid, jnp.sqrt(
+                    jnp.maximum(qe, 0.0)), 0.0)
+                return (w, qacc), None
+            (w, qacc), _ = lax.scan(body, (w, qacc), (xb, mask))
+            return w, qacc
+
+        self._train_batch_ = jax.jit(train_batch, donate_argnums=(0, 1))
+        self._qacc_ = jnp.zeros((), jnp.float32)
+        self._weights_dev_ = jnp.asarray(self.weights.map_read())
+
+    def _schedule(self):
+        t = min(self.epoch_number or 0, self.epochs) / max(self.epochs, 1)
+        lr = self.learning_rate * (
+            self.learning_rate_final / self.learning_rate) ** t
+        sigma = self.sigma * (self.sigma_final / self.sigma) ** t
+        return lr, sigma
+
+    def run(self):
+        if self.minibatch_class != loader_mod.TRAIN:
+            return
+        lr, sigma = self._schedule()
+        xb = self.minibatch_data.devmem
+        xb = xb.reshape(xb.shape[0], -1)
+        self._weights_dev_, self._qacc_ = self._train_batch_(
+            self._weights_dev_, self._qacc_, xb,
+            int(self.minibatch_size), lr, sigma)
+        self._epoch_samples += int(self.minibatch_size)
+        if bool(self.last_minibatch):
+            import jax
+            self.qerror.map_write()[0] = (
+                float(jax.device_get(self._qacc_)) /
+                max(self._epoch_samples, 1))
+            import jax.numpy as jnp
+            self._qacc_ = jnp.zeros((), jnp.float32)
+            self._epoch_samples = 0
+            self.weights.devmem = self._weights_dev_
+
+    def get_metric_values(self):
+        return {"mean_quantization_error": float(self.qerror[0])}
+
+
+class KohonenDecision(Unit, IResultProvider):
+    """Epoch counter + quantization-error tracker for SOM training."""
+
+    MAPPING = "kohonen_decision"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "DECISION"
+        self.max_epochs = int(kwargs.get("max_epochs", 50))
+        self.silent = bool(kwargs.get("silent", False))
+        from ..mutable import Bool
+        self.complete = Bool(False)
+        self.qerror = None               # linked from trainer
+        self.epoch_number = None         # linked from loader
+        self.epoch_ended = None
+        self.qerror_history = []
+
+    def link_loader(self, loader):
+        self.link_attrs(loader, "epoch_number", "epoch_ended")
+        return self
+
+    def link_trainer(self, trainer):
+        self.link_attrs(trainer, "qerror")
+        return self
+
+    def run(self):
+        if not bool(self.epoch_ended):
+            return
+        qe = float(self.qerror[0])
+        self.qerror_history.append(qe)
+        if not self.silent:
+            print("Epoch %d: mean quantization error %.4f" %
+                  (self.epoch_number, qe))
+        if self.epoch_number + 1 >= self.max_epochs:
+            self.complete <<= True
+
+    def get_metric_values(self):
+        return {"final_quantization_error":
+                self.qerror_history[-1] if self.qerror_history else None,
+                "epochs": len(self.qerror_history)}

@@ -1,0 +1,86 @@
+"""MnistAE: fully-connected MNIST autoencoder (BASELINE gate model).
+
+Re-creation of the Znicz MnistAE sample (absent submodule; published
+baseline — 0.5478 validation RMSE — from
+/root/reference/docs/source/manualrst_veles_algorithms.rst:55-69).
+
+Topology: 784 → tanh(100) → linear(784), trained with MSE against the
+input image itself (targets = data).  Rides the same MSE stack the
+regression workflows use: FullBatchLoaderMSE serves (data, targets) pairs
+resident in HBM, the fused step computes the 0.5·sum-squared-error loss,
+and DecisionMSE tracks per-epoch RMSE with early stopping.
+"""
+
+import numpy
+
+from ...config import root
+from ...loader.fullbatch import FullBatchLoaderMSE
+from ...loader.base import TEST, VALID, TRAIN
+from ...datasets import load_mnist
+from ..standard_workflow import StandardWorkflow
+
+root.mnist_ae.update({
+    "loader": {"minibatch_size": 100,
+               "normalization_type": "range_linear",
+               "target_normalization_type": "range_linear"},
+    "layers": [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 100,
+                                        "weights_stddev": 0.05},
+         "<-": {"learning_rate": 0.01, "weights_decay": 0.0,
+                "gradient_moment": 0.9}},
+        {"type": "all2all", "->": {"output_sample_shape": 784,
+                                   "weights_stddev": 0.05},
+         "<-": {"learning_rate": 0.01, "weights_decay": 0.0,
+                "gradient_moment": 0.9}},
+    ],
+    "decision": {"max_epochs": 20, "fail_iterations": 20},
+})
+
+
+class MnistAELoader(FullBatchLoaderMSE):
+    """MNIST with the images doubling as regression targets."""
+
+    MAPPING = "mnist_ae_loader"
+
+    def __init__(self, workflow, **kwargs):
+        self.n_train = kwargs.pop("n_train", None)
+        self.n_valid = kwargs.pop("n_valid", None)
+        super().__init__(workflow, **kwargs)
+
+    def load_data(self):
+        (ti, tl), (vi, vl), self.is_real = load_mnist(
+            self.n_train, self.n_valid)
+        data = numpy.concatenate([vi, ti]).astype(numpy.float32)
+        data = data.reshape(len(data), -1)
+        self.original_data.mem = data
+        self.original_targets.mem = data.copy()
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = len(vi)
+        self.class_lengths[TRAIN] = len(ti)
+
+
+def create_workflow(fused=True, **overrides):
+    cfg = root.mnist_ae
+    decision = cfg.decision.todict()
+    decision.update(overrides.pop("decision", {}))
+    loader = cfg.loader.todict()
+    loader.update(overrides.pop("loader", {}))
+    layers = overrides.pop("layers", cfg.layers)
+    if "snapshotter" in cfg and "snapshotter" not in overrides:
+        overrides["snapshotter"] = cfg.snapshotter.todict()
+    return StandardWorkflow(
+        None,
+        name="MnistAE",
+        loader_factory=MnistAELoader,
+        loader=loader,
+        layers=layers,
+        loss_function="mse",
+        decision=decision,
+        fused=fused,
+        **overrides,
+    )
+
+
+def run(load, main):
+    load(create_workflow)
+    main()
