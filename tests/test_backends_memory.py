@@ -139,3 +139,22 @@ def test_device_benchmark_unit():
     b = DeviceBenchmark(wf, size=128, repeats=1)
     b.initialize(device=Device(backend="cpu"))
     assert b.estimate() > 0
+
+
+def test_precision_level_knob():
+    """precision_level 0/1/2 → jax matmul precision (the reference's GEMM
+    PRECISION_LEVEL plain/Kahan/multipartial knob, veles/config.py:
+    245-248)."""
+    import jax
+    from veles_tpu.backends import Device
+    from veles_tpu.config import root
+    before = jax.config.jax_default_matmul_precision
+    try:
+        Device(backend="cpu", precision_level=2)
+        assert str(jax.config.jax_default_matmul_precision) == "highest"
+        root.common.engine.precision_level = 1
+        Device(backend="cpu")
+        assert str(jax.config.jax_default_matmul_precision) == "high"
+    finally:
+        root.common.engine.precision_level = 0
+        jax.config.update("jax_default_matmul_precision", before)

@@ -60,9 +60,30 @@ class Device(metaclass=BackendRegistry):
                 (backend, ", ".join(sorted(BackendRegistry.backends))))
         return super().__new__(impl)
 
+    #: config precision_level → jax matmul precision.  The reference's
+    #: GEMM PRECISION_LEVEL 0/1/2 (plain / Kahan / 32-partial summation,
+    #: ocl/matrix_multiplication_precise.cl:37,119-170) maps onto the
+    #: MXU's pass-decomposition knob: DEFAULT (fast bf16 passes), HIGH
+    #: (3-pass), HIGHEST (6-pass / f32 accumulation) — same
+    #: speed-vs-summation-error trade, implemented by the hardware.
+    PRECISION_LEVELS = {0: "default", 1: "high", 2: "highest"}
+
     def __init__(self, **kwargs):
         self._compute_power = None
         self._lock = threading.Lock()
+        level = kwargs.get("precision_level")
+        if level is None:
+            level = root.common.engine.get("precision_level", 0)
+        level = int(level)
+        if level not in self.PRECISION_LEVELS:
+            raise ValueError(
+                "precision_level must be one of %s, got %r"
+                % (sorted(self.PRECISION_LEVELS), level))
+        import jax
+        # always applied — level 0 must RESET a prior device's elevated
+        # precision, or every later workflow silently pays 3-6x matmuls
+        jax.config.update("jax_default_matmul_precision",
+                          self.PRECISION_LEVELS[level])
 
     # Devices ride along in workflow snapshots only as stubs: locks and
     # PJRT handles cannot pickle, and a restored workflow is re-attached

@@ -18,9 +18,7 @@ probability-averaging ensemble inference on device.
 
 import json
 import os
-import subprocess
 import sys
-import tempfile
 
 import numpy
 
@@ -30,33 +28,24 @@ def train(model, size, train_ratio=1.0, argv=(), out_file=None,
           env=None):
     """Train ``size`` instances, return the aggregated results dict."""
     python = python or sys.executable
-    repo = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
+    from ..subproc import run_trial
     instances = []
     for i in range(size):
-        fd, result_file = tempfile.mkstemp(
-            prefix="veles-tpu-ensemble-%d-" % i, suffix=".json")
-        os.close(fd)
-        try:
-            cmd = ([python, "-m", "veles_tpu", model] + list(argv) +
-                   ["root.common.ensemble.train_ratio=%r" % train_ratio,
-                    "--random-seed", str(base_seed + i),
-                    "--result-file", result_file])
-            proc = subprocess.run(cmd, timeout=timeout,
-                                  capture_output=True, cwd=repo, env=env)
-            entry = {"instance": i, "seed": base_seed + i,
-                     "rc": proc.returncode}
-            if proc.returncode == 0 and os.path.getsize(result_file):
-                with open(result_file) as f:
-                    entry["results"] = json.load(f)
-            else:
-                entry["error"] = proc.stderr.decode()[-2000:]
-            instances.append(entry)
-        finally:
-            os.unlink(result_file)
+        rc, results, error = run_trial(
+            model,
+            list(argv) +
+            ["root.common.ensemble.train_ratio=%r" % train_ratio,
+             "--random-seed", str(base_seed + i)],
+            timeout=timeout, env=env, python=python)
+        entry = {"instance": i, "seed": base_seed + i, "rc": rc}
+        if results is not None:
+            entry["results"] = results
+        else:
+            entry["error"] = error
+        instances.append(entry)
         if not silent:
             print("ensemble instance %d/%d: rc=%d %s" % (
-                i + 1, size, proc.returncode,
+                i + 1, size, rc,
                 entry.get("results", entry.get("error", ""))))
     summary = aggregate(instances)
     out = {"model": model, "size": size, "train_ratio": train_ratio,

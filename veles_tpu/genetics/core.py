@@ -201,7 +201,8 @@ class Population:
         pool = sorted(
             (self.chromosomes[self.rand.randint(0, len(self))]
              for _ in range(int(len(self) * pool_ratio))),
-            key=lambda c: -(c.fitness or -numpy.inf))
+            key=lambda c: -(c.fitness if c.fitness is not None
+                            else -numpy.inf))
         return pool[:count]
 
     # -- crossover ops (reference core.py:633-760) --------------------------

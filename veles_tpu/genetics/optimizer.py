@@ -13,11 +13,7 @@ JSON; we read ``fitness_key`` (default ``best_validation_error_pt``) and
 negate it when ``minimize`` (default) so the GA always maximizes.
 """
 
-import json
-import os
-import subprocess
 import sys
-import tempfile
 
 from ..config import root, get_config_ranges
 from ..prng import RandomGenerator
@@ -97,36 +93,23 @@ class GeneticsOptimizer:
         return fitness
 
     def _evaluate_subprocess(self, assignments):
-        fd, result_file = tempfile.mkstemp(prefix="veles-tpu-ga-",
-                                           suffix=".json")
-        os.close(fd)
+        from ..subproc import run_trial
+        argv = self.argv + ["%s=%r" % (path, value)
+                            for path, value in assignments.items()]
+        rc, result, error = run_trial(self.model, argv,
+                                      timeout=self.timeout, env=self.env,
+                                      python=self.python)
+        if result is None:
+            # failed trial = worst possible fitness (the reference raised
+            # EvaluationError and dropped the chromosome)
+            return self._trial_failed(error)
         try:
-            argv = ([self.python, "-m", "veles_tpu", self.model] +
-                    self.argv +
-                    ["%s=%r" % (path, value)
-                     for path, value in assignments.items()] +
-                    ["--result-file", result_file])
-            proc = subprocess.run(
-                argv, timeout=self.timeout, capture_output=True,
-                env=self.env,
-                cwd=os.path.dirname(os.path.dirname(
-                    os.path.dirname(os.path.abspath(__file__)))))
-            if proc.returncode:
-                # failed trial = worst possible fitness (the reference
-                # raised EvaluationError and dropped the chromosome)
-                return self._trial_failed(
-                    "exit %d: %s" % (proc.returncode,
-                                     proc.stderr.decode()[-1500:]))
-            with open(result_file) as f:
-                result = json.load(f)
             value = float(result[self.fitness_key])
-            return -value if self.minimize else value
-        except subprocess.TimeoutExpired:
-            return self._trial_failed("timeout after %ss" % self.timeout)
-        except (KeyError, ValueError, json.JSONDecodeError) as e:
-            return self._trial_failed("bad result JSON: %r" % e)
-        finally:
-            os.unlink(result_file)
+        except (KeyError, TypeError, ValueError):
+            return self._trial_failed(
+                "result JSON lacks numeric %r: %s"
+                % (self.fitness_key, sorted(result)))
+        return -value if self.minimize else value
 
     def _trial_failed(self, reason):
         self.failures += 1

@@ -41,6 +41,16 @@ class Launcher:
         self.finish_time = None
         self.on_initialized = []        # callbacks(workflow)
         self.on_finished = []           # callbacks(workflow)
+        self.status_server = None
+        status_port = kwargs.pop("status_port", None)
+        if status_port is None:
+            status_port = root.common.web_status.get("port", None)
+        if status_port is not None and not stealth:
+            # in-process HTTP status side-car (reference launcher.py:
+            # 852-885 posted heartbeats to an external Tornado server);
+            # serve() reuses a live server on the same port
+            from .web_status import serve
+            self.status_server = serve(int(status_port))
         self._extra = kwargs
 
     # -- lifecycle -----------------------------------------------------------
@@ -74,6 +84,9 @@ class Launcher:
     def stop(self):
         if self.workflow is not None:
             self.workflow.stop()
+        if self.status_server is not None:
+            self.status_server.stop()
+            self.status_server = None
 
     # -- results -------------------------------------------------------------
     def gather_results(self):

@@ -1,0 +1,146 @@
+"""Logger subsystem: class-level loggers + JSONL event tracing.
+
+TPU-native re-creation of /root/reference/veles/logger.py: the reference
+gave every class a colored console logger (:1-200) and an
+``event(name, "begin"|"end"|"single", **info)`` stream duplicated into
+MongoDB (:264-289).  Here the event stream is a **Chrome-trace JSONL
+file** (one event object per line, ``ph`` B/E/X/i phases) — loadable in
+Perfetto/chrome://tracing next to jax-profiler traces, greppable, and
+zero-dependency — instead of a Mongo collection.
+
+Enable via config::
+
+    root.common.trace.enabled = True
+    root.common.trace.file = "events.jsonl"      # default: events dir
+
+or ``Unit.execute`` emits per-run spans automatically when enabled.
+"""
+
+import atexit
+import json
+import logging
+import os
+import sys
+import threading
+import time
+
+from .config import root
+
+_COLORS = {"DEBUG": "\033[37m", "INFO": "\033[32m", "WARNING": "\033[33m",
+           "ERROR": "\033[31m", "CRITICAL": "\033[41m"}
+_RESET = "\033[0m"
+
+
+class ColorFormatter(logging.Formatter):
+    """Reference-style colored console lines (logger.py:60-120)."""
+
+    def format(self, record):
+        text = super().format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelname, "")
+            return "%s%s%s" % (color, text, _RESET) if color else text
+        return text
+
+
+def setup_logging(level=logging.INFO, file=None):
+    """Install the colored console handler (+ optional duplicate-to-file,
+    reference Logger.redirect_all_logging_to_file)."""
+    rt = logging.getLogger()
+    rt.setLevel(level)
+    rt.handlers = [h for h in rt.handlers
+                   if not getattr(h, "_veles_tpu", False)]
+    console = logging.StreamHandler()
+    console.setFormatter(ColorFormatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s", "%H:%M:%S"))
+    console._veles_tpu = True
+    rt.addHandler(console)
+    if file:
+        fh = logging.FileHandler(file)
+        fh.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        fh._veles_tpu = True
+        rt.addHandler(fh)
+
+
+class Logger:
+    """Mixin giving every class its own named logger (reference
+    veles/logger.py Logger mixin)."""
+
+    @property
+    def logger(self):
+        return logging.getLogger(type(self).__name__)
+
+    def debug(self, msg, *args):
+        self.logger.debug(msg, *args)
+
+    def info(self, msg, *args):
+        self.logger.info(msg, *args)
+
+    def warning(self, msg, *args):
+        self.logger.warning(msg, *args)
+
+    def error(self, msg, *args):
+        self.logger.error(msg, *args)
+
+
+class EventLog:
+    """Chrome-trace JSONL writer (the Mongo events replacement).
+
+    Phases: ``begin``/``end`` spans, ``single`` instants, and ``span``
+    complete events with explicit duration — mapping to trace-viewer
+    ``B``/``E``/``i``/``X``."""
+
+    _PH = {"begin": "B", "end": "E", "single": "i", "span": "X"}
+
+    def __init__(self, path=None):
+        self._path = path
+        self._file = None
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+
+    @property
+    def enabled(self):
+        return bool(root.common.trace.get("enabled", False))
+
+    def _ensure_open(self):
+        if self._file is not None:
+            return
+        path = (self._path or root.common.trace.get("file") or
+                os.path.join(root.common.dirs.get("events", "."),
+                             "events-%d.jsonl" % os.getpid()))
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._file = open(path, "a", buffering=1)  # line buffered
+        self.path = path
+        atexit.register(self.close)
+
+    def event(self, name, kind="single", duration=None, **info):
+        """Record one event; no-op unless tracing is enabled."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ensure_open()
+            ts = time.time() - self._t0
+            if duration is not None:
+                ts -= duration  # trace-viewer X events anchor at start
+            record = {"name": name, "ph": self._PH.get(kind, "i"),
+                      "ts": round(ts * 1e6, 1),
+                      "pid": os.getpid(), "tid": threading.get_ident()}
+            if duration is not None:
+                record["dur"] = round(duration * 1e6, 1)
+            if info:
+                record["args"] = info
+            self._file.write(json.dumps(record) + "\n")
+
+    def span(self, name, seconds, **info):
+        """Complete span ending now, lasting ``seconds``."""
+        self.event(name, "span", duration=seconds, **info)
+
+    def close(self):
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+#: process-global event log (reference: per-node Mongo duplication)
+events = EventLog()

@@ -1,0 +1,187 @@
+"""Plotting units: serialize training curves/matrices/images per epoch.
+
+TPU-native re-design of /root/reference/veles/plotting_units.py +
+graphics_server.py: the reference pickled whole Plotter objects onto a
+ZMQ pub socket for a separate matplotlib process to render
+(graphics_server.py:65-113, graphics_client.py:84-380).  Here each
+plotter **serializes its data** — one JSONL record per update into the
+plots directory — and can optionally render a PNG directly (matplotlib
+is in-process; there is no GIL-bound GPU queue to protect, so the
+separate-renderer-process architecture is dead weight on TPU).
+
+Units: AccumulatingPlotter (scalar series), MatrixPlotter (confusion
+matrix), Histogram (value distribution), ImagePlotter (sample grids —
+reference image plotters).  All run at epoch end via ``gate_skip``
+wiring done in ``link_decision``/``link_loader``.
+"""
+
+import json
+import os
+import time
+
+import numpy
+
+from .config import root
+from .units import Unit
+
+
+class Plotter(Unit):
+    """Base: appends one JSONL record per update; optional PNG render."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "PLOTTER"
+        self.runs_after_stop = True  # final epoch must still be plotted
+        self.plot_name = kwargs.get("name", type(self).__name__)
+        self.directory = kwargs.get("directory") or \
+            root.common.dirs.get("plots", ".")
+        self.render = bool(kwargs.get("render", False))
+        self.last_minibatch = None   # linked; plot once per epoch/class
+        self.epoch_ended = None
+        self._records = 0
+
+    def link_loader(self, loader):
+        """Run only when an epoch completes (gate_skip on other runs)."""
+        self.link_attrs(loader, "epoch_ended", "last_minibatch")
+        self.gate_skip = ~loader.epoch_ended
+        return self
+
+    @property
+    def path(self):
+        return os.path.join(self.directory, self.plot_name + ".jsonl")
+
+    def emit(self, payload):
+        os.makedirs(self.directory, exist_ok=True)
+        payload = {"plot": self.plot_name, "t": round(time.time(), 3),
+                   **payload}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(payload) + "\n")
+        self._records += 1
+        if self.render:
+            try:
+                self.render_png()
+            except Exception:
+                pass  # rendering is best-effort; data is already on disk
+
+    def render_png(self):
+        pass
+
+
+class AccumulatingPlotter(Plotter):
+    """Scalar-vs-epoch series (reference AccumulatingPlotter): watches a
+    linked ``input`` attribute, one point per run."""
+
+    MAPPING = "accumulating_plotter"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input = None            # linked: any scalar-ish attribute
+        self.input_field = kwargs.get("input_field")
+        self.series = []
+
+    def run(self):
+        value = self.input
+        if self.input_field is not None:
+            value = value[self.input_field] if isinstance(value, (list,
+                                                                  dict)) \
+                else getattr(value, self.input_field)
+        value = float(value)
+        self.series.append(value)
+        self.emit({"epoch": len(self.series) - 1, "value": value})
+
+    def render_png(self):
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        fig, ax = plt.subplots()
+        ax.plot(self.series)
+        ax.set_xlabel("epoch")
+        ax.set_ylabel(self.plot_name)
+        fig.savefig(os.path.join(self.directory, self.plot_name + ".png"))
+        plt.close(fig)
+
+
+class MatrixPlotter(Plotter):
+    """Confusion-matrix snapshots (reference MatrixPlotter)."""
+
+    MAPPING = "matrix_plotter"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input = None            # linked: confusion_matrix Array
+
+    def run(self):
+        m = self.input
+        m = numpy.asarray(m.map_read() if hasattr(m, "map_read") else m)
+        self.emit({"shape": list(m.shape), "matrix": m.tolist()})
+
+    def render_png(self):
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        m = self.input
+        m = numpy.asarray(m.map_read() if hasattr(m, "map_read") else m)
+        fig, ax = plt.subplots()
+        ax.imshow(m, cmap="viridis")
+        ax.set_xlabel("true")
+        ax.set_ylabel("predicted")
+        fig.savefig(os.path.join(self.directory, self.plot_name + ".png"))
+        plt.close(fig)
+
+
+class Histogram(Plotter):
+    """Value-distribution histogram (reference Histogram /
+    MultiHistogram), e.g. of a weights Array."""
+
+    MAPPING = "histogram_plotter"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input = None
+        self.n_bins = int(kwargs.get("n_bins", 50))
+
+    def run(self):
+        v = self.input
+        v = numpy.asarray(v.map_read() if hasattr(v, "map_read") else v)
+        counts, edges = numpy.histogram(v.ravel(), bins=self.n_bins)
+        self.emit({"counts": counts.tolist(), "edges": edges.tolist()})
+
+
+class ImagePlotter(Plotter):
+    """Sample-image grids (reference ImagePlotter/plotting image units):
+    saves the first ``count`` samples of the linked Array as PNG."""
+
+    MAPPING = "image_plotter"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input = None
+        self.count = int(kwargs.get("count", 16))
+        self.sample_shape = kwargs.get("sample_shape")  # e.g. (28, 28)
+
+    def run(self):
+        v = self.input
+        v = numpy.asarray(v.map_read() if hasattr(v, "map_read") else v)
+        v = v[:self.count]
+        if self.sample_shape is not None:
+            v = v.reshape((len(v),) + tuple(self.sample_shape))
+        path = os.path.join(self.directory, self.plot_name + ".png")
+        os.makedirs(self.directory, exist_ok=True)
+        self._save_grid(v, path)
+        self.emit({"png": path, "count": int(len(v))})
+
+    @staticmethod
+    def _save_grid(images, path, cols=4):
+        from PIL import Image
+        images = numpy.asarray(images, numpy.float64)
+        lo, hi = images.min(), images.max()
+        images = ((images - lo) / (hi - lo + 1e-12) * 255).astype("uint8")
+        n, h, w = images.shape[0], images.shape[1], images.shape[2]
+        rows = (n + cols - 1) // cols
+        grid = numpy.zeros((rows * h, cols * w) + images.shape[3:], "uint8")
+        for i, img in enumerate(images):
+            r, c = divmod(i, cols)
+            grid[r * h:(r + 1) * h, c * w:(c + 1) * w] = img
+        Image.fromarray(grid).save(path)

@@ -1,0 +1,46 @@
+"""Shared CLI-trial runner for the meta-schedulers (GA, ensembles).
+
+Both the genetic optimizer and the ensemble trainer evaluate a model by
+re-invoking ``python -m veles_tpu`` as a subprocess with a temp result
+file — the same pattern the reference used for its meta-workflows
+(optimization_workflow.py:286-296, ensemble/base_workflow.py:134-141).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_trial(model, argv, timeout=None, env=None, python=None):
+    """Run one CLI trial; returns (rc, results_dict_or_None, error_text).
+
+    ``rc`` is the subprocess exit code (-1 for timeout); ``results`` is
+    the parsed ``--result-file`` JSON when the trial succeeded."""
+    python = python or sys.executable
+    fd, result_file = tempfile.mkstemp(prefix="veles-tpu-trial-",
+                                       suffix=".json")
+    os.close(fd)
+    try:
+        cmd = ([python, "-m", "veles_tpu", model] + list(argv) +
+               ["--result-file", result_file])
+        try:
+            proc = subprocess.run(cmd, timeout=timeout,
+                                  capture_output=True, cwd=REPO_ROOT,
+                                  env=env)
+        except subprocess.TimeoutExpired:
+            return -1, None, "timeout after %ss" % timeout
+        if proc.returncode:
+            return (proc.returncode, None,
+                    "exit %d: %s" % (proc.returncode,
+                                     proc.stderr.decode()[-2000:]))
+        try:
+            with open(result_file) as f:
+                return 0, json.load(f), None
+        except (ValueError, json.JSONDecodeError) as e:
+            return 0, None, "bad result JSON: %r" % e
+    finally:
+        os.unlink(result_file)

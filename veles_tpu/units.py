@@ -76,6 +76,9 @@ class Unit(Lockable, IDistributable, metaclass=UnitRegistry):
         self.gate_block = Bool(False)
         self.gate_skip = Bool(False)
         self.ignores_gate = False   # Repeater-style: any input opens the gate
+        # service side-branches (plotters, status reporters) set this so
+        # the final iteration still reaches them after EndPoint fires
+        self.runs_after_stop = False
         self.stopped = False   # set by the unit itself to stop propagating;
         #                        reset by FireStarter (reference units.py:823)
         self.exports = []      # attr names included in package_export
@@ -221,7 +224,8 @@ class Unit(Lockable, IDistributable, metaclass=UnitRegistry):
     def execute(self, schedule):
         """Run (unless gate_skip) and propagate to dependents."""
         wf = self._workflow
-        if wf is not None and wf.is_finished and not self.ignores_gate:
+        if wf is not None and wf.is_finished and \
+                not (self.ignores_gate or self.runs_after_stop):
             # run-after-stop: a linking bug in the graph (units.py:823-839)
             wf.warning_run_after_stop(self)
             return
@@ -234,6 +238,12 @@ class Unit(Lockable, IDistributable, metaclass=UnitRegistry):
             name = self.__class__.__name__
             if name in root.common.get("timings", set()):
                 print("%s: run %.3f ms" % (self.name, dt * 1e3))
+            if root.common.trace.get("enabled", False):
+                # per-run span into the JSONL event stream (the Mongo
+                # event replacement — reference logger.py:264-289 wrapped
+                # run the same way)
+                from .logger import events
+                events.span(self.name, dt, cls=name)
         if self.stopped and not isinstance(self, Container):
             return  # unit declared itself done; FireStarter can revive it
         self.run_dependent(schedule)

@@ -1,0 +1,167 @@
+"""Web status: live training status over HTTP.
+
+TPU-native re-design of /root/reference/veles/web_status.py (:113-244):
+the reference ran a separate Tornado server that masters POSTed
+heartbeats to (``/update``) and browsers polled, garbage-collecting dead
+masters.  Here a stdlib ``ThreadingHTTPServer`` runs in-process on a
+daemon thread:
+
+- ``GET /status``  → JSON of every registered workflow (name, epoch,
+  metrics, per-unit timing, age);
+- ``POST /update`` → external masters may still push heartbeats (kept
+  for protocol parity — a multi-host launcher posts here);
+- ``GET /``        → minimal HTML auto-refreshing view.
+
+The ``StatusReporter`` unit updates the in-process registry once per
+epoch; dead entries age out after ``gc_timeout`` like the reference's
+garbage collection.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .units import Unit
+
+
+class StatusRegistry:
+    """Thread-safe workflow-status store with age-out."""
+
+    def __init__(self, gc_timeout=180.0):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self.gc_timeout = gc_timeout
+
+    def update(self, key, payload):
+        with self._lock:
+            self._entries[key] = {"t": time.time(), **payload}
+
+    def snapshot(self):
+        now = time.time()
+        with self._lock:
+            self._entries = {k: v for k, v in self._entries.items()
+                             if now - v["t"] < self.gc_timeout}
+            return {k: {**v, "age": round(now - v["t"], 1)}
+                    for k, v in self._entries.items()}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry = None
+
+    def log_message(self, *args):
+        pass  # silent; the event log is the observability channel
+
+    def _send(self, code, body, ctype="application/json"):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path.startswith("/status"):
+            self._send(200, json.dumps(self.registry.snapshot(), indent=2))
+        elif self.path == "/":
+            rows = []
+            for key, e in sorted(self.registry.snapshot().items()):
+                rows.append(
+                    "<tr><td>%s</td><td>%s</td><td>%s</td><td>%ss</td>"
+                    "</tr>" % (key, e.get("epoch", "-"),
+                               json.dumps(e.get("metrics", {})),
+                               e.get("age", 0)))
+            self._send(200, (
+                "<html><head><meta http-equiv=refresh content=5>"
+                "<title>veles_tpu status</title></head><body>"
+                "<h2>Workflows</h2><table border=1>"
+                "<tr><th>workflow</th><th>epoch</th><th>metrics</th>"
+                "<th>age</th></tr>%s</table></body></html>"
+                % "".join(rows)), "text/html")
+        else:
+            self._send(404, '{"error": "not found"}')
+
+    def do_POST(self):
+        if self.path != "/update":
+            self._send(404, '{"error": "not found"}')
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            key = payload.pop("id", self.client_address[0])
+            self.registry.update(key, payload)
+            self._send(200, '{"ok": true}')
+        except (ValueError, json.JSONDecodeError):
+            self._send(400, '{"error": "bad json"}')
+
+
+#: process-default registry: reporters publish here, servers serve it
+default_registry = StatusRegistry()
+
+
+class StatusServer:
+    """In-process HTTP status server on a daemon thread."""
+
+    def __init__(self, port=0, registry=None):
+        self.registry = registry or default_registry
+        handler = type("Handler", (_Handler,), {"registry": self.registry})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="veles-tpu-web-status")
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        _servers.pop(self.port, None)
+
+
+_servers = {}
+
+
+def serve(port=0, registry=None):
+    """Start (or reuse) the status server on ``port`` — a second Launcher
+    in the same process must not crash with EADDRINUSE on the socket the
+    first one's daemon thread still holds."""
+    if port and port in _servers:
+        return _servers[port]
+    server = StatusServer(port, registry)
+    _servers[server.port] = server
+    return server
+
+
+class StatusReporter(Unit):
+    """Per-epoch heartbeat into a StatusRegistry (reference masters
+    POSTing /update, web_status.py:113)."""
+
+    MAPPING = "status_reporter"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.runs_after_stop = True  # report the final epoch too
+        self.registry = kwargs.get("registry") or default_registry
+        self.epoch_ended = None      # linked
+        self.epoch_number = None
+
+    def link_loader(self, loader):
+        self.link_attrs(loader, "epoch_ended", "epoch_number")
+        self.gate_skip = ~loader.epoch_ended
+        return self
+
+    def run(self):
+        wf = self._workflow
+        metrics = {}
+        try:
+            metrics = wf.gather_results()
+        except Exception:
+            pass
+        self.registry.update(wf.name, {
+            "epoch": self.epoch_number,
+            "metrics": {k: v for k, v in metrics.items()
+                        if isinstance(v, (int, float, str)) and
+                        not isinstance(v, bool)},
+            "units": len(list(wf)),
+        })

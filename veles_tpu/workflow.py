@@ -164,8 +164,14 @@ class Workflow(Container):
         schedule = self._queue_.append
         try:
             self.start_point.execute(schedule)
-            while self._queue_ and not self._is_finished_:
+            while self._queue_:
                 unit = self._queue_.popleft()
+                if self._is_finished_ and not (unit.runs_after_stop or
+                                               unit.ignores_gate):
+                    # scheduled before EndPoint fired this iteration;
+                    # only service side-branches (plotters, reporters)
+                    # still observe the final state
+                    continue
                 unit.execute(schedule)
         finally:
             self._queue_.clear()

@@ -190,7 +190,10 @@ class KohonenTrainer(KohonenBase, IResultProvider):
             import jax.numpy as jnp
             self._qacc_ = jnp.zeros((), jnp.float32)
             self._epoch_samples = 0
-            self.weights.devmem = self._weights_dev_
+            # publish a COPY: the live buffer is donated by the next
+            # train step, which would leave readers of the public Array
+            # holding a deleted device buffer
+            self.weights.devmem = jnp.array(self._weights_dev_)
 
     def get_metric_values(self):
         return {"mean_quantization_error": float(self.qerror[0])}

@@ -68,6 +68,8 @@ class StandardWorkflow(Workflow):
         self.trainer_config = dict(kwargs.get("trainer", {}))
         self.snapshotter_config = kwargs.get("snapshotter")  # dict|None
         self.snapshotter = None
+        self.web_status = kwargs.get("web_status", False)
+        self.status_reporter = None
         loader_factory = kwargs.get("loader_factory")
         if loader_factory is None:
             raise ValueError("StandardWorkflow requires loader_factory")
@@ -155,6 +157,16 @@ class StandardWorkflow(Workflow):
             # after an improvement would snapshot again
             self.snapshotter.skip = ~(self.decision.improved &
                                       self.loader.valid_ended)
+
+        if self.web_status:
+            # heartbeat side-branch: fires off the decision each epoch,
+            # does not gate the training loop
+            from ..web_status import StatusReporter
+            cfg = self.web_status if isinstance(self.web_status, dict) \
+                else {}
+            self.status_reporter = StatusReporter(self, **cfg)
+            self.status_reporter.link_from(self.decision)
+            self.status_reporter.link_loader(self.loader)
 
         if self.fused:
             self._build_fused()
