@@ -1,0 +1,82 @@
+// C API for the native engine — the ctypes binding surface.
+//
+// pybind11 is not available in this image; a flat C ABI consumed via
+// ctypes (veles_tpu/export/native.py) plays the same role the
+// reference's JNI/NDK surface did for libVeles (libVeles/android/).
+
+#include <cstring>
+#include <string>
+
+#include "engine.h"
+
+using veles_native::Tensor;
+using veles_native::Workflow;
+
+extern "C" {
+
+// Returns an opaque handle or nullptr (error text via veles_last_error).
+void* veles_load(const char* path);
+void veles_free(void* handle);
+// Runs the forward chain: input is [batch x sample_size] f32.  Writes up
+// to out_capacity floats into out, returns the number of output floats
+// (or -1 on error).  out_shape receives up to 8 dims; out_rank the rank.
+long veles_run(void* handle, const float* input, long batch,
+               const long* sample_shape, long sample_rank, float* out,
+               long out_capacity, long* out_shape, long* out_rank);
+const char* veles_last_error();
+const char* veles_workflow_name(void* handle);
+
+}  // extern "C"
+
+namespace {
+thread_local std::string g_error;
+}
+
+void* veles_load(const char* path) {
+  try {
+    return Workflow::Load(path).release();
+  } catch (const std::exception& e) {
+    g_error = e.what();
+    return nullptr;
+  }
+}
+
+void veles_free(void* handle) {
+  delete static_cast<Workflow*>(handle);
+}
+
+const char* veles_last_error() { return g_error.c_str(); }
+
+const char* veles_workflow_name(void* handle) {
+  return static_cast<Workflow*>(handle)->name().c_str();
+}
+
+long veles_run(void* handle, const float* input, long batch,
+               const long* sample_shape, long sample_rank, float* out,
+               long out_capacity, long* out_shape, long* out_rank) {
+  try {
+    auto* wf = static_cast<Workflow*>(handle);
+    Tensor in;
+    in.shape.push_back(static_cast<size_t>(batch));
+    size_t sample = 1;
+    for (long i = 0; i < sample_rank; ++i) {
+      in.shape.push_back(static_cast<size_t>(sample_shape[i]));
+      sample *= static_cast<size_t>(sample_shape[i]);
+    }
+    in.data.assign(input, input + batch * sample);
+    Tensor result = wf->Run(in);
+    long n = static_cast<long>(result.size());
+    if (n > out_capacity) {
+      g_error = "output buffer too small";
+      return -1;
+    }
+    std::memcpy(out, result.data.data(), n * sizeof(float));
+    *out_rank = static_cast<long>(result.shape.size());
+    for (size_t i = 0; i < result.shape.size() && i < 8; ++i)
+      out_shape[i] = static_cast<long>(result.shape[i]);
+    return n;
+  } catch (const std::exception& e) {
+    g_error = e.what();
+    return -1;
+  }
+}

@@ -1,0 +1,440 @@
+#include "engine.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <stdexcept>
+
+#include "zipreader.h"
+
+namespace veles_native {
+
+UnitRegistry& UnitRegistry::Instance() {
+  static UnitRegistry instance;
+  return instance;
+}
+
+void UnitRegistry::Register(const std::string& cls, UnitFactory factory) {
+  factories_[cls] = std::move(factory);
+}
+
+std::unique_ptr<Unit> UnitRegistry::Create(
+    const std::string& cls, const Json& config,
+    std::map<std::string, NpyArray> arrays) {
+  auto it = factories_.find(cls);
+  if (it == factories_.end())
+    throw std::runtime_error("no native unit registered for class " + cls);
+  return it->second(config, std::move(arrays));
+}
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Activations (shared by All2All*/Conv* variants)
+
+enum class Act { kNone, kTanh, kSigmoid, kRelu, kStrictRelu, kSoftmax };
+
+Act ActivationFor(const std::string& cls) {
+  if (cls.find("Tanh") != std::string::npos) return Act::kTanh;
+  if (cls.find("Sigmoid") != std::string::npos) return Act::kSigmoid;
+  if (cls.find("StrictRELU") != std::string::npos) return Act::kStrictRelu;
+  if (cls.find("RELU") != std::string::npos) return Act::kRelu;
+  if (cls.find("Softmax") != std::string::npos) return Act::kSoftmax;
+  return Act::kNone;
+}
+
+void ApplyActivation(Act act, Tensor* t) {
+  float* d = t->data.data();
+  size_t n = t->size();
+  switch (act) {
+    case Act::kNone:
+      break;
+    case Act::kTanh:
+      // the Znicz scaled tanh: 1.7159 * tanh(0.6666 * x)
+      for (size_t i = 0; i < n; ++i)
+        d[i] = 1.7159f * std::tanh(0.6666f * d[i]);
+      break;
+    case Act::kSigmoid:
+      for (size_t i = 0; i < n; ++i) d[i] = 1.0f / (1.0f + std::exp(-d[i]));
+      break;
+    case Act::kRelu:
+      // Znicz RELU: log(1 + exp(x)), clamped for overflow
+      for (size_t i = 0; i < n; ++i)
+        d[i] = d[i] > 15.0f ? d[i] : std::log1p(std::exp(d[i]));
+      break;
+    case Act::kStrictRelu:
+      for (size_t i = 0; i < n; ++i) d[i] = std::max(0.0f, d[i]);
+      break;
+    case Act::kSoftmax: {
+      size_t batch = t->shape[0], width = t->sample_size();
+      for (size_t b = 0; b < batch; ++b) {
+        float* row = d + b * width;
+        float mx = row[0];
+        for (size_t j = 1; j < width; ++j) mx = std::max(mx, row[j]);
+        float sum = 0.0f;
+        for (size_t j = 0; j < width; ++j) {
+          row[j] = std::exp(row[j] - mx);
+          sum += row[j];
+        }
+        for (size_t j = 0; j < width; ++j) row[j] /= sum;
+      }
+      break;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// All2All: y = x @ W (+ b), activation fused
+
+class All2AllUnit : public Unit {
+ public:
+  All2AllUnit(Act act, NpyArray weights, NpyArray bias, bool has_bias)
+      : act_(act), w_(std::move(weights)), b_(std::move(bias)),
+        has_bias_(has_bias) {}
+
+  void Run(const Tensor& in, Tensor* out) const override {
+    size_t batch = in.shape[0];
+    size_t n_in = w_.shape[0], n_out = w_.shape[1];
+    if (in.sample_size() != n_in)
+      throw std::runtime_error("all2all input width mismatch");
+    out->shape = {batch, n_out};
+    out->data.assign(batch * n_out, 0.0f);
+    const float* x = in.data.data();
+    const float* w = w_.data.data();
+    float* y = out->data.data();
+    for (size_t b = 0; b < batch; ++b) {
+      const float* xr = x + b * n_in;
+      float* yr = y + b * n_out;
+      for (size_t i = 0; i < n_in; ++i) {
+        float xv = xr[i];
+        if (xv == 0.0f) continue;
+        const float* wr = w + i * n_out;
+        for (size_t j = 0; j < n_out; ++j) yr[j] += xv * wr[j];
+      }
+      if (has_bias_)
+        for (size_t j = 0; j < n_out; ++j) yr[j] += b_.data[j];
+    }
+    ApplyActivation(act_, out);
+  }
+
+ private:
+  Act act_;
+  NpyArray w_, b_;
+  bool has_bias_;
+};
+
+// ---------------------------------------------------------------------------
+// Conv: NHWC x HWIO direct convolution, activation fused
+
+class ConvUnit : public Unit {
+ public:
+  ConvUnit(Act act, const Json& cfg, NpyArray weights, NpyArray bias,
+           bool has_bias)
+      : act_(act), w_(std::move(weights)), b_(std::move(bias)),
+        has_bias_(has_bias) {
+    const Json& pad = cfg["padding"];
+    for (size_t i = 0; i < 4; ++i)
+      padding_[i] = static_cast<long>(pad[i].number);
+    sy_ = cfg["sliding"][0].as_int();
+    sx_ = cfg["sliding"][1].as_int();
+    grouping_ = cfg.has("grouping") ? cfg["grouping"].as_int() : 1;
+  }
+
+  void Run(const Tensor& in, Tensor* out) const override {
+    size_t batch = in.shape[0], h = in.shape[1], w = in.shape[2],
+           c_in = in.shape[3];
+    size_t ky = w_.shape[0], kx = w_.shape[1], c_g = w_.shape[2],
+           n_k = w_.shape[3];
+    long pt = padding_[0], pb = padding_[1], pl = padding_[2],
+         pr = padding_[3];
+    size_t oh = (h + pt + pb - ky) / sy_ + 1;
+    size_t ow = (w + pl + pr - kx) / sx_ + 1;
+    size_t g = static_cast<size_t>(grouping_);
+    size_t kpg = n_k / g;  // kernels per group
+    out->shape = {batch, oh, ow, n_k};
+    out->data.assign(batch * oh * ow * n_k, 0.0f);
+    for (size_t b = 0; b < batch; ++b) {
+      for (size_t oy = 0; oy < oh; ++oy) {
+        for (size_t ox = 0; ox < ow; ++ox) {
+          float* yr =
+              &out->data[((b * oh + oy) * ow + ox) * n_k];
+          for (size_t dy = 0; dy < ky; ++dy) {
+            long iy = static_cast<long>(oy * sy_ + dy) - pt;
+            if (iy < 0 || iy >= static_cast<long>(h)) continue;
+            for (size_t dx = 0; dx < kx; ++dx) {
+              long ix = static_cast<long>(ox * sx_ + dx) - pl;
+              if (ix < 0 || ix >= static_cast<long>(w)) continue;
+              const float* xr =
+                  &in.data[((b * h + iy) * w + ix) * c_in];
+              const float* wr = &w_.data[(dy * kx + dx) * c_g * n_k];
+              for (size_t gi = 0; gi < g; ++gi) {
+                for (size_t ci = 0; ci < c_g; ++ci) {
+                  float xv = xr[gi * c_g + ci];
+                  if (xv == 0.0f) continue;
+                  const float* wk = wr + ci * n_k + gi * kpg;
+                  float* yk = yr + gi * kpg;
+                  for (size_t k = 0; k < kpg; ++k) yk[k] += xv * wk[k];
+                }
+              }
+            }
+          }
+          if (has_bias_)
+            for (size_t k = 0; k < n_k; ++k) yr[k] += b_.data[k];
+        }
+      }
+    }
+    ApplyActivation(act_, out);
+  }
+
+ private:
+  Act act_;
+  NpyArray w_, b_;
+  bool has_bias_;
+  long padding_[4];
+  long sy_, sx_, grouping_;
+};
+
+// ---------------------------------------------------------------------------
+// Pooling (max / avg)
+
+class PoolUnit : public Unit {
+ public:
+  PoolUnit(bool is_max, const Json& cfg) : is_max_(is_max) {
+    ky_ = cfg["ky"].as_int();
+    kx_ = cfg["kx"].as_int();
+    const Json& pad = cfg["padding"];
+    for (size_t i = 0; i < 4; ++i)
+      padding_[i] = static_cast<long>(pad[i].number);
+    sy_ = cfg["sliding"][0].as_int();
+    sx_ = cfg["sliding"][1].as_int();
+  }
+
+  void Run(const Tensor& in, Tensor* out) const override {
+    size_t batch = in.shape[0], h = in.shape[1], w = in.shape[2],
+           c = in.shape[3];
+    long pt = padding_[0], pb = padding_[1], pl = padding_[2],
+         pr = padding_[3];
+    size_t oh = (h + pt + pb - ky_) / sy_ + 1;
+    size_t ow = (w + pl + pr - kx_) / sx_ + 1;
+    out->shape = {batch, oh, ow, c};
+    out->data.assign(batch * oh * ow * c,
+                     is_max_ ? -3.4e38f : 0.0f);
+    for (size_t b = 0; b < batch; ++b)
+      for (size_t oy = 0; oy < oh; ++oy)
+        for (size_t ox = 0; ox < ow; ++ox) {
+          float* yr = &out->data[((b * oh + oy) * ow + ox) * c];
+          long n_seen = 0;
+          for (long dy = 0; dy < ky_; ++dy) {
+            long iy = static_cast<long>(oy * sy_) + dy - pt;
+            if (iy < 0 || iy >= static_cast<long>(h)) continue;
+            for (long dx = 0; dx < kx_; ++dx) {
+              long ix = static_cast<long>(ox * sx_) + dx - pl;
+              if (ix < 0 || ix >= static_cast<long>(w)) continue;
+              ++n_seen;
+              const float* xr =
+                  &in.data[((b * h + iy) * w + ix) * c];
+              if (is_max_) {
+                for (size_t ci = 0; ci < c; ++ci)
+                  yr[ci] = std::max(yr[ci], xr[ci]);
+              } else {
+                for (size_t ci = 0; ci < c; ++ci) yr[ci] += xr[ci];
+              }
+            }
+          }
+          if (!is_max_ && n_seen)
+            for (size_t ci = 0; ci < c; ++ci)
+              yr[ci] /= static_cast<float>(n_seen);
+        }
+  }
+
+ private:
+  bool is_max_;
+  long ky_, kx_, sy_, sx_;
+  long padding_[4];
+};
+
+// ---------------------------------------------------------------------------
+// LRN across channels (AlexNet local response normalization)
+
+class LRNUnit : public Unit {
+ public:
+  explicit LRNUnit(const Json& cfg) {
+    alpha_ = static_cast<float>(cfg["alpha"].number);
+    beta_ = static_cast<float>(cfg["beta"].number);
+    k_ = static_cast<float>(cfg["k"].number);
+    n_ = cfg["n"].as_int();
+  }
+
+  void Run(const Tensor& in, Tensor* out) const override {
+    out->shape = in.shape;
+    out->data.resize(in.size());
+    size_t c = in.shape.back();
+    size_t rows = in.size() / c;
+    long half = n_ / 2;
+    for (size_t r = 0; r < rows; ++r) {
+      const float* xr = &in.data[r * c];
+      float* yr = &out->data[r * c];
+      for (long ci = 0; ci < static_cast<long>(c); ++ci) {
+        float acc = 0.0f;
+        for (long d = -half; d < n_ - half; ++d) {
+          long j = ci + d;
+          if (j >= 0 && j < static_cast<long>(c)) acc += xr[j] * xr[j];
+        }
+        float den = std::pow(k_ + (alpha_ / n_) * acc, beta_);
+        yr[ci] = xr[ci] / den;
+      }
+    }
+  }
+
+ private:
+  float alpha_, beta_, k_;
+  long n_;
+};
+
+// ---------------------------------------------------------------------------
+// Identity (inference-time dropout)
+
+class IdentityUnit : public Unit {
+ public:
+  void Run(const Tensor& in, Tensor* out) const override { *out = in; }
+};
+
+class ActivationUnit : public Unit {
+ public:
+  explicit ActivationUnit(Act act) : act_(act) {}
+  void Run(const Tensor& in, Tensor* out) const override {
+    *out = in;
+    ApplyActivation(act_, out);
+  }
+
+ private:
+  Act act_;
+};
+
+// ---------------------------------------------------------------------------
+// registration
+
+NpyArray TakeArray(std::map<std::string, NpyArray>* arrays,
+                   const std::string& name) {
+  auto it = arrays->find(name);
+  if (it == arrays->end()) return NpyArray{};
+  NpyArray out = std::move(it->second);
+  arrays->erase(it);
+  return out;
+}
+
+bool RegisterBuiltins() {
+  auto& reg = UnitRegistry::Instance();
+  for (const char* cls :
+       {"All2All", "All2AllTanh", "All2AllSigmoid", "All2AllRELU",
+        "All2AllStrictRELU", "All2AllSoftmax", "ResizableAll2All"}) {
+    reg.Register(cls, [cls](const Json& cfg,
+                            std::map<std::string, NpyArray> arrays) {
+      NpyArray w = TakeArray(&arrays, "weights");
+      NpyArray b = TakeArray(&arrays, "bias");
+      bool has_bias = !b.data.empty();
+      if (cfg.has("include_bias") && !cfg["include_bias"].boolean)
+        has_bias = false;
+      return std::unique_ptr<Unit>(new All2AllUnit(
+          ActivationFor(cls), std::move(w), std::move(b), has_bias));
+    });
+  }
+  for (const char* cls : {"Conv", "ConvTanh", "ConvSigmoid", "ConvRELU",
+                          "ConvStrictRELU"}) {
+    reg.Register(cls, [cls](const Json& cfg,
+                            std::map<std::string, NpyArray> arrays) {
+      NpyArray w = TakeArray(&arrays, "weights");
+      NpyArray b = TakeArray(&arrays, "bias");
+      bool has_bias = !b.data.empty();
+      if (cfg.has("include_bias") && !cfg["include_bias"].boolean)
+        has_bias = false;
+      return std::unique_ptr<Unit>(new ConvUnit(
+          ActivationFor(cls), cfg, std::move(w), std::move(b), has_bias));
+    });
+  }
+  reg.Register("MaxPooling",
+               [](const Json& cfg, std::map<std::string, NpyArray>) {
+                 return std::unique_ptr<Unit>(new PoolUnit(true, cfg));
+               });
+  reg.Register("AvgPooling",
+               [](const Json& cfg, std::map<std::string, NpyArray>) {
+                 return std::unique_ptr<Unit>(new PoolUnit(false, cfg));
+               });
+  reg.Register("LRNormalizerForward",
+               [](const Json& cfg, std::map<std::string, NpyArray>) {
+                 return std::unique_ptr<Unit>(new LRNUnit(cfg));
+               });
+  reg.Register("DropoutForward",
+               [](const Json&, std::map<std::string, NpyArray>) {
+                 return std::unique_ptr<Unit>(new IdentityUnit());
+               });
+  // standalone activation units (znicz/activation.py Forward* family)
+  for (const char* cls : {"ForwardTanh", "ForwardSigmoid", "ForwardRELU",
+                          "ForwardStrictRELU"}) {
+    reg.Register(cls, [cls](const Json&,
+                            std::map<std::string, NpyArray>) {
+      return std::unique_ptr<Unit>(new ActivationUnit(ActivationFor(cls)));
+    });
+  }
+  return true;
+}
+
+const bool kRegistered = RegisterBuiltins();
+
+}  // namespace
+
+std::unique_ptr<Workflow> Workflow::Load(const std::string& path) {
+  (void)kRegistered;
+  ZipReader zip(path);
+  auto contents_bytes = zip.read("contents.json");
+  Json contents = Json::parse(
+      std::string(contents_bytes.begin(), contents_bytes.end()));
+  if (!zip.has("model.json"))
+    throw std::runtime_error("package lacks model.json (export with "
+                             "veles_tpu.export.export_model)");
+  auto meta_bytes = zip.read("model.json");
+  Json meta =
+      Json::parse(std::string(meta_bytes.begin(), meta_bytes.end()));
+
+  // unit name -> {attr -> npy file} from contents.json
+  std::map<std::string, std::map<std::string, std::string>> files;
+  for (const Json& u : contents["units"].array) {
+    if (!u.has("arrays")) continue;
+    for (const auto& kv : u["arrays"].object)
+      files[u["name"].as_string()][kv.first] =
+          kv.second["file"].as_string();
+  }
+
+  auto wf = std::unique_ptr<Workflow>(new Workflow());
+  wf->name_ = contents["workflow"].as_string();
+  for (const Json& d : meta["input"]["sample_shape"].array)
+    wf->input_sample_shape_.push_back(static_cast<size_t>(d.number));
+  for (const Json& fwd : meta["forwards"].array) {
+    const std::string& unit_name = fwd["unit"].as_string();
+    std::map<std::string, NpyArray> arrays;
+    auto fit = files.find(unit_name);
+    if (fit != files.end())
+      for (const auto& kv : fit->second)
+        arrays[kv.first] = load_npy(zip.read(kv.second));
+    auto unit = UnitRegistry::Instance().Create(
+        fwd["class"].as_string(), fwd["config"], std::move(arrays));
+    unit->name = unit_name;
+    wf->units_.push_back(std::move(unit));
+  }
+  return wf;
+}
+
+Tensor Workflow::Run(const Tensor& input) const {
+  Tensor a = input, b;
+  const Tensor* cur = &a;
+  Tensor* next = &b;
+  for (const auto& unit : units_) {
+    unit->Run(*cur, next);
+    std::swap(a, b);
+    cur = &a;
+    next = &b;
+  }
+  return a;
+}
+
+}  // namespace veles_native

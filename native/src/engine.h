@@ -1,0 +1,80 @@
+// Native inference engine: forward-chain execution of a veles_tpu
+// package.
+//
+// Plays the libVeles engine + unit-factory role
+// (/root/reference/libVeles/src/engine.cc, unit_factory.cc:37-65,
+// workflow.cc:73-95): units are constructed by class name from the
+// package metadata and run in chain order over flat float32 tensors.
+// Memory planning is two ping-pong buffers (the memory_optimizer.cc
+// skyline packer is overkill for a linear forward chain).
+#pragma once
+
+#include <cstddef>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "json.h"
+#include "npy.h"
+
+namespace veles_native {
+
+struct Tensor {
+  std::vector<size_t> shape;  // [batch, ...sample dims]
+  std::vector<float> data;
+
+  size_t size() const {
+    size_t n = 1;
+    for (size_t d : shape) n *= d;
+    return n;
+  }
+  size_t sample_size() const { return shape.empty() ? 0 : size() / shape[0]; }
+};
+
+class Unit {
+ public:
+  virtual ~Unit() = default;
+  virtual void Run(const Tensor& in, Tensor* out) const = 0;
+  std::string name;
+};
+
+// Factory registry keyed by the Python class name recorded in
+// model.json (reference keyed by UUID; class names are the stable ids
+// in this package format).
+using UnitFactory = std::function<std::unique_ptr<Unit>(
+    const Json& config, std::map<std::string, NpyArray> arrays)>;
+
+class UnitRegistry {
+ public:
+  static UnitRegistry& Instance();
+  void Register(const std::string& cls, UnitFactory factory);
+  std::unique_ptr<Unit> Create(const std::string& cls, const Json& config,
+                               std::map<std::string, NpyArray> arrays);
+
+ private:
+  std::map<std::string, UnitFactory> factories_;
+};
+
+class Workflow {
+ public:
+  // Load from a package zip written by veles_tpu.export.export_model.
+  static std::unique_ptr<Workflow> Load(const std::string& path);
+
+  // Run the forward chain on a [batch, sample...] input.
+  Tensor Run(const Tensor& input) const;
+
+  const std::string& name() const { return name_; }
+  size_t num_units() const { return units_.size(); }
+  const std::vector<size_t>& input_sample_shape() const {
+    return input_sample_shape_;
+  }
+
+ private:
+  std::string name_;
+  std::vector<size_t> input_sample_shape_;
+  std::vector<std::unique_ptr<Unit>> units_;
+};
+
+}  // namespace veles_native

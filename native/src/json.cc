@@ -1,0 +1,142 @@
+#include "json.h"
+
+#include <cctype>
+#include <cstdlib>
+
+namespace veles_native {
+
+namespace {
+
+struct Parser {
+  const char* p;
+  const char* end;
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' ||
+                       *p == '\r'))
+      ++p;
+  }
+
+  [[noreturn]] void fail(const std::string& what) {
+    throw std::runtime_error("json parse error: " + what);
+  }
+
+  char peek() {
+    skip_ws();
+    if (p >= end) fail("unexpected end");
+    return *p;
+  }
+
+  void expect(char c) {
+    if (peek() != c) fail(std::string("expected '") + c + "'");
+    ++p;
+  }
+
+  std::string parse_string() {
+    expect('"');
+    std::string out;
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        ++p;
+        if (p >= end) fail("bad escape");
+        switch (*p) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case '/': out += '/'; break;
+          case '\\': out += '\\'; break;
+          case '"': out += '"'; break;
+          case 'u': {  // decode BMP escapes as UTF-8
+            if (end - p < 5) fail("bad \\u escape");
+            unsigned code = static_cast<unsigned>(
+                std::strtoul(std::string(p + 1, p + 5).c_str(),
+                             nullptr, 16));
+            p += 4;
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: fail("bad escape");
+        }
+        ++p;
+      } else {
+        out += *p++;
+      }
+    }
+    expect('"');
+    return out;
+  }
+
+  Json parse_value() {
+    char c = peek();
+    Json v;
+    if (c == '{') {
+      ++p;
+      v.type = Json::Type::Object;
+      if (peek() == '}') { ++p; return v; }
+      while (true) {
+        std::string key = parse_string();
+        expect(':');
+        v.object[key] = parse_value();
+        char n = peek();
+        if (n == ',') { ++p; continue; }
+        expect('}');
+        break;
+      }
+    } else if (c == '[') {
+      ++p;
+      v.type = Json::Type::Array;
+      if (peek() == ']') { ++p; return v; }
+      while (true) {
+        v.array.push_back(parse_value());
+        char n = peek();
+        if (n == ',') { ++p; continue; }
+        expect(']');
+        break;
+      }
+    } else if (c == '"') {
+      v.type = Json::Type::String;
+      v.str = parse_string();
+    } else if (c == 't') {
+      if (end - p < 4 || std::string(p, p + 4) != "true") fail("true");
+      p += 4;
+      v.type = Json::Type::Bool;
+      v.boolean = true;
+    } else if (c == 'f') {
+      if (end - p < 5 || std::string(p, p + 5) != "false") fail("false");
+      p += 5;
+      v.type = Json::Type::Bool;
+    } else if (c == 'n') {
+      if (end - p < 4 || std::string(p, p + 4) != "null") fail("null");
+      p += 4;
+    } else {
+      char* num_end = nullptr;
+      v.type = Json::Type::Number;
+      v.number = std::strtod(p, &num_end);
+      if (num_end == p) fail("number");
+      p = num_end;
+    }
+    return v;
+  }
+};
+
+}  // namespace
+
+Json Json::parse(const std::string& text) {
+  Parser parser{text.data(), text.data() + text.size()};
+  Json v = parser.parse_value();
+  parser.skip_ws();
+  return v;
+}
+
+}  // namespace veles_native

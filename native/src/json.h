@@ -1,0 +1,46 @@
+// Minimal JSON parser (objects, arrays, strings, numbers, bools, null).
+//
+// Plays the rapidjson role of the reference native runtime
+// (/root/reference/libVeles/src/main_file_loader.cc parsed contents.json
+// with the vendored rapidjson submodule) without vendoring anything.
+#pragma once
+
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Type type = Type::Null;
+  bool boolean = false;
+  double number = 0.0;
+  std::string str;
+  std::vector<Json> array;
+  std::map<std::string, Json> object;
+
+  static Json parse(const std::string& text);
+
+  bool has(const std::string& key) const {
+    return type == Type::Object && object.count(key) != 0;
+  }
+  const Json& operator[](const std::string& key) const {
+    auto it = object.find(key);
+    if (it == object.end())
+      throw std::runtime_error("json key missing: " + key);
+    return it->second;
+  }
+  const Json& operator[](size_t i) const { return array.at(i); }
+  size_t size() const {
+    return type == Type::Array ? array.size() : object.size();
+  }
+  const std::string& as_string() const { return str; }
+  long as_int() const { return static_cast<long>(number); }
+};
+
+}  // namespace veles_native

@@ -1,0 +1,90 @@
+// CLI runner: veles_native_run <package.zip> <input.npy|random> [out.npy]
+//
+// The native equivalent of `python -m veles_tpu.export.loader` — loads
+// the package without any Python and executes the forward chain
+// (reference: libVeles sample usage, workflow_loader.h).
+
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <random>
+
+#include "engine.h"
+#include "npy.h"
+
+using veles_native::NpyArray;
+using veles_native::Tensor;
+using veles_native::Workflow;
+
+namespace {
+
+std::vector<uint8_t> read_file(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  f.seekg(0, std::ios::end);
+  std::vector<uint8_t> data(static_cast<size_t>(f.tellg()));
+  f.seekg(0);
+  f.read(reinterpret_cast<char*>(data.data()),
+         static_cast<std::streamsize>(data.size()));
+  return data;
+}
+
+void write_npy_f32(const std::string& path, const Tensor& t) {
+  std::string shape;
+  for (size_t i = 0; i < t.shape.size(); ++i) {
+    shape += std::to_string(t.shape[i]);
+    shape += ", ";
+  }
+  std::string header = "{'descr': '<f4', 'fortran_order': False, "
+                       "'shape': (" + shape + "), }";
+  size_t total = 10 + header.size() + 1;
+  size_t pad = (64 - total % 64) % 64;
+  header += std::string(pad, ' ');
+  header += '\n';
+  std::ofstream f(path, std::ios::binary);
+  uint16_t hlen = static_cast<uint16_t>(header.size());
+  f.write("\x93NUMPY\x01\x00", 8);
+  f.write(reinterpret_cast<const char*>(&hlen), 2);
+  f.write(header.data(), static_cast<std::streamsize>(header.size()));
+  f.write(reinterpret_cast<const char*>(t.data.data()),
+          static_cast<std::streamsize>(t.data.size() * 4));
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: %s <package.zip> <input.npy|random> [out.npy]\n",
+                 argv[0]);
+    return 2;
+  }
+  try {
+    auto wf = Workflow::Load(argv[1]);
+    Tensor in;
+    if (std::strcmp(argv[2], "random") == 0) {
+      in.shape.push_back(2);
+      for (size_t d : wf->input_sample_shape()) in.shape.push_back(d);
+      in.data.resize(in.size());
+      std::mt19937 rng(0);
+      std::uniform_real_distribution<float> dist(-1.0f, 1.0f);
+      for (float& v : in.data) v = dist(rng);
+    } else {
+      NpyArray arr = veles_native::load_npy(read_file(argv[2]));
+      in.shape = arr.shape;
+      in.data = std::move(arr.data);
+    }
+    Tensor out = wf->Run(in);
+    std::printf("workflow %s: %zu units, input [", wf->name().c_str(),
+                wf->num_units());
+    for (size_t d : in.shape) std::printf("%zu,", d);
+    std::printf("] -> output [");
+    for (size_t d : out.shape) std::printf("%zu,", d);
+    std::printf("]\n");
+    if (argc > 3) write_npy_f32(argv[3], out);
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

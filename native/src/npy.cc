@@ -1,0 +1,136 @@
+#include "npy.h"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace veles_native {
+
+namespace {
+
+float half_to_float(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t frac = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (frac == 0) {
+      bits = sign;  // +-0
+    } else {        // subnormal: normalize
+      exp = 127 - 15 + 1;
+      while ((frac & 0x400) == 0) {
+        frac <<= 1;
+        --exp;
+      }
+      frac &= 0x3FF;
+      bits = sign | (exp << 23) | (frac << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000u | (frac << 13);  // inf/nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (frac << 13);
+  }
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+std::string header_field(const std::string& header,
+                         const std::string& key) {
+  size_t pos = header.find("'" + key + "'");
+  if (pos == std::string::npos)
+    throw std::runtime_error("npy header lacks " + key);
+  pos = header.find(':', pos);
+  size_t end = pos + 1;
+  int depth = 0;
+  while (end < header.size()) {
+    char c = header[end];
+    if (c == '(' || c == '[') ++depth;
+    if (c == ')' || c == ']') --depth;
+    if ((c == ',' || c == '}') && depth <= 0) break;
+    ++end;
+  }
+  return header.substr(pos + 1, end - pos - 1);
+}
+
+}  // namespace
+
+NpyArray load_npy(const std::vector<uint8_t>& bytes) {
+  if (bytes.size() < 10 ||
+      std::memcmp(bytes.data(), "\x93NUMPY", 6) != 0)
+    throw std::runtime_error("not an npy file");
+  uint8_t major = bytes[6];
+  size_t header_len, header_off;
+  if (major == 1) {
+    header_len = bytes[8] | (bytes[9] << 8);
+    header_off = 10;
+  } else {
+    header_len = bytes[8] | (bytes[9] << 8) |
+                 (static_cast<size_t>(bytes[10]) << 16) |
+                 (static_cast<size_t>(bytes[11]) << 24);
+    header_off = 12;
+  }
+  std::string header(reinterpret_cast<const char*>(&bytes[header_off]),
+                     header_len);
+  std::string descr = header_field(header, "descr");
+  std::string order = header_field(header, "fortran_order");
+  if (order.find("True") != std::string::npos)
+    throw std::runtime_error("fortran_order npy unsupported");
+  std::string shape_s = header_field(header, "shape");
+
+  NpyArray out;
+  for (size_t i = 0; i < shape_s.size();) {
+    if (isdigit(static_cast<unsigned char>(shape_s[i]))) {
+      size_t j = i;
+      while (j < shape_s.size() &&
+             isdigit(static_cast<unsigned char>(shape_s[j])))
+        ++j;
+      out.shape.push_back(
+          static_cast<size_t>(std::stoul(shape_s.substr(i, j - i))));
+      i = j;
+    } else {
+      ++i;
+    }
+  }
+  size_t n = out.size();
+  const uint8_t* payload = bytes.data() + header_off + header_len;
+  size_t avail = bytes.size() - header_off - header_len;
+  out.data.resize(n);
+  auto need = [&](size_t bytes_per) {
+    if (avail < n * bytes_per)
+      throw std::runtime_error("npy payload truncated");
+  };
+  if (descr.find("<f4") != std::string::npos) {
+    need(4);
+    std::memcpy(out.data.data(), payload, n * 4);
+  } else if (descr.find("<f2") != std::string::npos) {
+    need(2);
+    const uint16_t* h = reinterpret_cast<const uint16_t*>(payload);
+    for (size_t i = 0; i < n; ++i) out.data[i] = half_to_float(h[i]);
+  } else if (descr.find("<f8") != std::string::npos) {
+    need(8);
+    const double* d = reinterpret_cast<const double*>(payload);
+    for (size_t i = 0; i < n; ++i) out.data[i] = static_cast<float>(d[i]);
+  } else if (descr.find("<i4") != std::string::npos) {
+    need(4);
+    const int32_t* v = reinterpret_cast<const int32_t*>(payload);
+    for (size_t i = 0; i < n; ++i) out.data[i] = static_cast<float>(v[i]);
+  } else if (descr.find("<i8") != std::string::npos) {
+    need(8);
+    const int64_t* v = reinterpret_cast<const int64_t*>(payload);
+    for (size_t i = 0; i < n; ++i) out.data[i] = static_cast<float>(v[i]);
+  } else if (descr.find("|i1") != std::string::npos) {
+    need(1);
+    const int8_t* v = reinterpret_cast<const int8_t*>(payload);
+    for (size_t i = 0; i < n; ++i) out.data[i] = static_cast<float>(v[i]);
+  } else if (descr.find("|b1") != std::string::npos ||
+             descr.find("|u1") != std::string::npos) {
+    need(1);
+    for (size_t i = 0; i < n; ++i)
+      out.data[i] = static_cast<float>(payload[i]);
+  } else {
+    throw std::runtime_error("unsupported npy dtype: " + descr);
+  }
+  return out;
+}
+
+}  // namespace veles_native

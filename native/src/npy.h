@@ -1,0 +1,29 @@
+// Minimal .npy (NumPy array file) loader with fp16 -> fp32 promotion.
+//
+// Plays the numpy_array_loader role of the reference native runtime
+// (/root/reference/libVeles/src/numpy_array_loader.cc — mmap .npy,
+// fp16->fp32 promote, transpose support).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+struct NpyArray {
+  std::vector<size_t> shape;
+  std::vector<float> data;  // always promoted to f32
+
+  size_t size() const {
+    size_t n = 1;
+    for (size_t d : shape) n *= d;
+    return n;
+  }
+};
+
+// Parse a .npy file image (v1/v2 headers; dtypes <f2, <f4, <f8,
+// <i1..<i8, |b1).  Throws std::runtime_error on unsupported input.
+NpyArray load_npy(const std::vector<uint8_t>& bytes);
+
+}  // namespace veles_native

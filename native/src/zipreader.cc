@@ -1,0 +1,112 @@
+#include "zipreader.h"
+
+#include <zlib.h>
+
+#include <cstring>
+#include <fstream>
+#include <stdexcept>
+
+namespace veles_native {
+
+namespace {
+
+uint16_t rd16(const uint8_t* p) {
+  return static_cast<uint16_t>(p[0] | (p[1] << 8));
+}
+uint32_t rd32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+std::vector<uint8_t> read_file(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  f.seekg(0, std::ios::end);
+  std::vector<uint8_t> data(static_cast<size_t>(f.tellg()));
+  f.seekg(0);
+  f.read(reinterpret_cast<char*>(data.data()),
+         static_cast<std::streamsize>(data.size()));
+  return data;
+}
+
+}  // namespace
+
+ZipReader::ZipReader(const std::string& path) : path_(path) {
+  std::vector<uint8_t> data = read_file(path);
+  // find End Of Central Directory (EOCD) signature scanning backwards
+  const uint32_t kEOCD = 0x06054b50, kCDIR = 0x02014b50;
+  if (data.size() < 22) throw std::runtime_error("not a zip: " + path);
+  size_t eocd = std::string::npos;
+  for (size_t i = data.size() - 22; ; --i) {
+    if (rd32(&data[i]) == kEOCD) { eocd = i; break; }
+    if (i == 0) break;
+  }
+  if (eocd == std::string::npos)
+    throw std::runtime_error("zip EOCD not found: " + path);
+  uint16_t count = rd16(&data[eocd + 10]);
+  uint32_t cdir_off = rd32(&data[eocd + 16]);
+  size_t p = cdir_off;
+  for (uint16_t i = 0; i < count; ++i) {
+    if (p + 46 > data.size() || rd32(&data[p]) != kCDIR)
+      throw std::runtime_error("zip central directory corrupt");
+    Entry e;
+    e.method = rd16(&data[p + 10]);
+    e.comp_size = rd32(&data[p + 20]);
+    e.uncomp_size = rd32(&data[p + 24]);
+    uint16_t name_len = rd16(&data[p + 28]);
+    uint16_t extra_len = rd16(&data[p + 30]);
+    uint16_t comment_len = rd16(&data[p + 32]);
+    e.offset = rd32(&data[p + 42]);
+    std::string name(reinterpret_cast<const char*>(&data[p + 46]),
+                     name_len);
+    entries_[name] = e;
+    p += 46u + name_len + extra_len + comment_len;
+  }
+}
+
+std::vector<std::string> ZipReader::names() const {
+  std::vector<std::string> out;
+  for (const auto& kv : entries_) out.push_back(kv.first);
+  return out;
+}
+
+std::vector<uint8_t> ZipReader::read(const std::string& name) const {
+  auto it = entries_.find(name);
+  if (it == entries_.end())
+    throw std::runtime_error("zip entry not found: " + name);
+  const Entry& e = it->second;
+  std::ifstream f(path_, std::ios::binary);
+  // local header: sig(4) ver(2) flags(2) method(2) time(4) crc(4)
+  // csize(4) usize(4) namelen(2) extralen(2)
+  uint8_t lh[30];
+  f.seekg(static_cast<std::streamoff>(e.offset));
+  f.read(reinterpret_cast<char*>(lh), 30);
+  if (rd32(lh) != 0x04034b50)
+    throw std::runtime_error("zip local header corrupt: " + name);
+  uint16_t name_len = rd16(lh + 26), extra_len = rd16(lh + 28);
+  f.seekg(static_cast<std::streamoff>(e.offset + 30 + name_len +
+                                      extra_len));
+  std::vector<uint8_t> comp(e.comp_size);
+  f.read(reinterpret_cast<char*>(comp.data()),
+         static_cast<std::streamsize>(comp.size()));
+  if (e.method == 0) return comp;  // stored
+  if (e.method != 8)
+    throw std::runtime_error("unsupported zip method for " + name);
+  std::vector<uint8_t> out(e.uncomp_size);
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  if (inflateInit2(&zs, -MAX_WBITS) != Z_OK)  // raw deflate
+    throw std::runtime_error("inflateInit failed");
+  zs.next_in = comp.data();
+  zs.avail_in = static_cast<uInt>(comp.size());
+  zs.next_out = out.data();
+  zs.avail_out = static_cast<uInt>(out.size());
+  int rc = inflate(&zs, Z_FINISH);
+  inflateEnd(&zs);
+  if (rc != Z_STREAM_END)
+    throw std::runtime_error("inflate failed for " + name);
+  return out;
+}
+
+}  // namespace veles_native

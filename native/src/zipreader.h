@@ -1,0 +1,38 @@
+// Minimal ZIP archive reader (stored + deflate entries via zlib).
+//
+// Plays the iarchivestream/libarchive role of the reference native
+// runtime (/root/reference/libVeles/src/iarchivestream.cc,
+// workflow_archive.cc) for the veles_tpu package format, which is a
+// standard ZIP written by Python's zipfile.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+class ZipReader {
+ public:
+  explicit ZipReader(const std::string& path);
+
+  bool has(const std::string& name) const {
+    return entries_.count(name) != 0;
+  }
+  std::vector<std::string> names() const;
+  // Decompressed file contents; throws std::runtime_error on failure.
+  std::vector<uint8_t> read(const std::string& name) const;
+
+ private:
+  struct Entry {
+    uint64_t offset;        // local header offset
+    uint64_t comp_size;
+    uint64_t uncomp_size;
+    uint16_t method;        // 0 = stored, 8 = deflate
+  };
+  std::string path_;
+  std::map<std::string, Entry> entries_;
+};
+
+}  // namespace veles_native

@@ -1,0 +1,119 @@
+// Assert-based self-test of the package-independent pieces: json, npy,
+// and unit kernels (reference had one gtest file per class,
+// libVeles/tests/; gtest is not vendored here so plain asserts run
+// under ctest).
+
+#undef NDEBUG
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+#include "engine.h"
+#include "json.h"
+#include "npy.h"
+
+using veles_native::Json;
+using veles_native::NpyArray;
+using veles_native::Tensor;
+
+namespace {
+
+void test_json() {
+  Json v = Json::parse(
+      "{\"a\": 1.5, \"b\": [1, 2, {\"c\": \"x\\ny\"}], \"t\": true,"
+      " \"n\": null, \"neg\": -2e3}");
+  assert(v["a"].number == 1.5);
+  assert(v["b"].size() == 3);
+  assert(v["b"][2]["c"].as_string() == "x\ny");
+  assert(v["t"].boolean);
+  assert(v["neg"].number == -2000.0);
+  assert(!v.has("missing"));
+}
+
+void test_npy() {
+  // hand-build a v1 .npy: 2x2 <f4 [[1,2],[3,4]]
+  const char header[] =
+      "{'descr': '<f4', 'fortran_order': False, 'shape': (2, 2), }";
+  std::string h(header);
+  while ((10 + h.size() + 1) % 16 != 0) h += ' ';
+  h += '\n';
+  std::vector<uint8_t> bytes;
+  const char magic[] = "\x93NUMPY\x01\x00";
+  bytes.insert(bytes.end(), magic, magic + 8);
+  bytes.push_back(static_cast<uint8_t>(h.size() & 0xFF));
+  bytes.push_back(static_cast<uint8_t>(h.size() >> 8));
+  bytes.insert(bytes.end(), h.begin(), h.end());
+  float data[4] = {1, 2, 3, 4};
+  const uint8_t* d = reinterpret_cast<const uint8_t*>(data);
+  bytes.insert(bytes.end(), d, d + 16);
+  NpyArray arr = veles_native::load_npy(bytes);
+  assert(arr.shape.size() == 2 && arr.shape[0] == 2 && arr.shape[1] == 2);
+  assert(arr.data[3] == 4.0f);
+
+  // fp16 promotion: 1.0h == 0x3C00
+  std::vector<uint8_t> half_bytes;
+  std::string h2 =
+      "{'descr': '<f2', 'fortran_order': False, 'shape': (1,), }";
+  while ((10 + h2.size() + 1) % 16 != 0) h2 += ' ';
+  h2 += '\n';
+  half_bytes.insert(half_bytes.end(), magic, magic + 8);
+  half_bytes.push_back(static_cast<uint8_t>(h2.size() & 0xFF));
+  half_bytes.push_back(static_cast<uint8_t>(h2.size() >> 8));
+  half_bytes.insert(half_bytes.end(), h2.begin(), h2.end());
+  half_bytes.push_back(0x00);
+  half_bytes.push_back(0x3C);
+  NpyArray harr = veles_native::load_npy(half_bytes);
+  assert(harr.data.size() == 1 && harr.data[0] == 1.0f);
+}
+
+void test_all2all_kernel() {
+  // y = x @ W + b with softmax head must produce a prob distribution
+  Json cfg = Json::parse("{\"include_bias\": true}");
+  std::map<std::string, NpyArray> arrays;
+  NpyArray w;
+  w.shape = {2, 3};
+  w.data = {1, 0, -1, 0, 1, 0};
+  NpyArray b;
+  b.shape = {3};
+  b.data = {0.1f, 0.2f, 0.3f};
+  arrays["weights"] = w;
+  arrays["bias"] = b;
+  auto unit = veles_native::UnitRegistry::Instance().Create(
+      "All2AllSoftmax", cfg, std::move(arrays));
+  Tensor in;
+  in.shape = {1, 2};
+  in.data = {1.0f, 2.0f};
+  Tensor out;
+  unit->Run(in, &out);
+  assert(out.shape[0] == 1 && out.shape[1] == 3);
+  float sum = out.data[0] + out.data[1] + out.data[2];
+  assert(std::fabs(sum - 1.0f) < 1e-5f);
+  // logits: [1.1, 2.2, -0.7] → argmax = 1
+  assert(out.data[1] > out.data[0] && out.data[1] > out.data[2]);
+}
+
+void test_pooling_kernel() {
+  Json cfg = Json::parse(
+      "{\"kx\": 2, \"ky\": 2, \"padding\": [0,0,0,0], "
+      "\"sliding\": [2,2]}");
+  auto unit = veles_native::UnitRegistry::Instance().Create(
+      "MaxPooling", cfg, {});
+  Tensor in;
+  in.shape = {1, 2, 2, 1};
+  in.data = {1, 5, 3, 2};
+  Tensor out;
+  unit->Run(in, &out);
+  assert(out.size() == 1 && out.data[0] == 5.0f);
+}
+
+}  // namespace
+
+int main() {
+  test_json();
+  test_npy();
+  test_all2all_kernel();
+  test_pooling_kernel();
+  std::printf("native selftest OK\n");
+  return 0;
+}

@@ -8,3 +8,5 @@ StableHLO program (``jax.export``) for the compiled inference path.
 """
 
 from .packager import package_export  # noqa: F401
+from .model import export_forward, export_model  # noqa: F401
+from .loader import PackageLoader  # noqa: F401

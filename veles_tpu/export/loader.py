@@ -1,0 +1,102 @@
+"""PackageLoader: read a workflow package back and run inference.
+
+The consumer half of the archive (reference libVeles
+workflow_loader.cc + numpy_array_loader.cc roles): parses
+``contents.json``, loads the ``.npy`` weights (promoting fp16 → fp32 the
+way numpy_array_loader.cc does), deserializes ``model.stablehlo`` and
+executes it with the weights as arguments.  Works on any JAX backend;
+the C++ runner (native/) reads the same layout.
+"""
+
+import io
+import json
+import zipfile
+
+import numpy
+
+
+class PackageLoader:
+    """Read-side of export.packager/export.model."""
+
+    def __init__(self, path):
+        self.path = path
+        with zipfile.ZipFile(path) as zf:
+            self.contents = json.loads(zf.read("contents.json"))
+            names = set(zf.namelist())
+            self.arrays = {}
+            for unit in self.contents["units"]:
+                for attr, meta in unit.get("arrays", {}).items():
+                    arr = numpy.load(io.BytesIO(zf.read(meta["file"])),
+                                     allow_pickle=False)
+                    if arr.dtype == numpy.float16:
+                        arr = arr.astype(numpy.float32)  # fp16 promote
+                    self.arrays.setdefault(unit["name"], {})[attr] = arr
+            self.model_metadata = (
+                json.loads(zf.read("model.json"))
+                if "model.json" in names else None)
+            self._artifact = (zf.read("model.stablehlo")
+                              if "model.stablehlo" in names else None)
+        self._exported = None
+
+    @property
+    def workflow_name(self):
+        return self.contents["workflow"]
+
+    @property
+    def checksum(self):
+        return self.contents.get("checksum")
+
+    def unit_params(self):
+        """Params pytree in forward order (what model.stablehlo takes)."""
+        if self.model_metadata is None:
+            raise ValueError("package has no model.json metadata")
+        params = []
+        for fwd in self.model_metadata["forwards"]:
+            unit_arrays = self.arrays.get(fwd["unit"], {})
+            params.append({name: unit_arrays[name]
+                           for name in fwd["params"]})
+        return params
+
+    def deserialize(self):
+        if self._artifact is None:
+            raise ValueError("package has no model.stablehlo artifact")
+        if self._exported is None:
+            from jax import export as jexport
+            self._exported = jexport.deserialize(self._artifact)
+        return self._exported
+
+    def run(self, x):
+        """Execute the exported model on a batch (any size when the
+        package was exported batch-polymorphic)."""
+        import jax.numpy as jnp
+        exported = self.deserialize()
+        x = jnp.asarray(numpy.asarray(x, numpy.float32))
+        return exported.call(self.unit_params(), x)
+
+
+def main(argv=None):
+    """``python -m veles_tpu.export.loader pkg.zip input.npy [out.npy]`` —
+    the minimal runner (PJRT plays the libVeles engine role)."""
+    import argparse
+    p = argparse.ArgumentParser(prog="veles_tpu.export.loader")
+    p.add_argument("package")
+    p.add_argument("input", help=".npy batch, or 'random' for a smoke run")
+    p.add_argument("output", nargs="?", default=None)
+    args = p.parse_args(argv)
+    loader = PackageLoader(args.package)
+    if args.input == "random":
+        meta = loader.model_metadata["input"]
+        x = numpy.random.RandomState(0).uniform(
+            -1, 1, [2] + meta["sample_shape"]).astype(numpy.float32)
+    else:
+        x = numpy.load(args.input)
+    out = numpy.asarray(loader.run(x))
+    print("workflow %r: input %s -> output %s" %
+          (loader.workflow_name, x.shape, out.shape))
+    if args.output:
+        numpy.save(args.output, out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

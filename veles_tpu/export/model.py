@@ -1,0 +1,80 @@
+"""StableHLO model export: the compiled-inference half of the package.
+
+The reference's package was consumed by libVeles, which re-implemented
+every unit in C++ and replayed the graph
+(/root/reference/libVeles/src/workflow_loader.cc, unit_factory.cc:37-65).
+The TPU-native design (SURVEY.md §2.10 mapping) replaces that per-unit
+C++ zoo with **one serialized StableHLO program**: ``jax.export`` of the
+whole forward chain, batch-size polymorphic, plus the weights as ``.npy``
+files the loader feeds back in as call arguments.  Any PJRT-capable
+runtime (CPU, TPU, the C++ PJRT C API) can then execute the model without
+knowing what a "unit" is; XLA owns buffer planning (the
+memory_optimizer.cc role).
+"""
+
+import json
+
+import numpy
+
+
+def forward_fn(forwards):
+    """The chained eval-mode apply over explicit params (pure)."""
+    def fn(params, x):
+        h = x
+        for i, fwd in enumerate(forwards):
+            h = fwd.apply(params[i], h)
+        return h
+    return fn
+
+
+def export_forward(workflow, batch="b"):
+    """Serialize the workflow's forward chain to StableHLO bytes.
+
+    ``batch``: symbolic dimension name (polymorphic batch — the package
+    serves any batch size) or an int for a static-batch artifact.
+
+    Returns (artifact_bytes, metadata_dict)."""
+    import jax
+    from jax import export as jexport
+
+    forwards = workflow.forwards
+    if not forwards:
+        raise ValueError("workflow has no forward units to export")
+    params = [f.params for f in forwards]
+    sample_shape = tuple(int(d)
+                         for d in forwards[0].input.shape[1:])
+    dtype = numpy.dtype(numpy.float32)
+    if isinstance(batch, str):
+        dims = jexport.symbolic_shape(
+            "%s, %s" % (batch, ", ".join(str(d) for d in sample_shape)))
+    else:
+        dims = (int(batch),) + sample_shape
+    x_struct = jax.ShapeDtypeStruct(dims, dtype)
+    params_struct = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(numpy.shape(a), a.dtype), params)
+    exported = jexport.export(jax.jit(forward_fn(forwards)))(
+        params_struct, x_struct)
+    metadata = {
+        "format": "jax.export/stablehlo",
+        "input": {"sample_shape": list(sample_shape),
+                  "dtype": str(dtype),
+                  "batch": batch},
+        "forwards": [
+            {"unit": f.name, "class": type(f).__name__,
+             "params": sorted(f.params),
+             "config": f.export_params()
+             if hasattr(f, "export_params") else {}}
+            for f in forwards],
+    }
+    return exported.serialize(), metadata
+
+
+def export_model(workflow, path, precision=32, batch="b"):
+    """Full package: arrays + contents.json + model.stablehlo + model.json
+    (the complete libVeles-package equivalent)."""
+    from .packager import package_export
+    artifact, metadata = export_forward(workflow, batch=batch)
+    return package_export(
+        workflow, path, precision=precision,
+        extra_files={"model.stablehlo": artifact,
+                     "model.json": json.dumps(metadata, indent=2)})

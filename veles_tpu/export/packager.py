@@ -28,8 +28,17 @@ def _exported_arrays(unit):
         value = getattr(unit, attr, None)
         if value is None:
             continue
-        mem = getattr(value, "mem", None)  # Array facade → host ndarray
-        arr = numpy.asarray(mem if mem is not None else value)
+        if hasattr(value, "map_read"):
+            if not value:
+                continue  # empty Array (e.g. paramless pooling "weights")
+            # Array facade: map_read pulls the freshest (possibly
+            # device-resident) value — raw ._mem may be stale after
+            # device-side training
+            arr = numpy.asarray(value.map_read())
+        else:
+            arr = numpy.asarray(value)
+        if arr.dtype == object:
+            continue  # not a tensor
         out[attr] = arr
     return out
 
@@ -52,6 +61,9 @@ def package_export(workflow, path, precision=32, extra_files=None):
         for attr, arr in exported.items():
             if numpy.issubdtype(arr.dtype, numpy.floating):
                 arr = arr.astype(fdtype)
+            # C-order always: consumers (incl. the native npy loader)
+            # do not handle fortran_order files
+            arr = numpy.ascontiguousarray(arr)
             zname = "%s/%s.npy" % (unit.name.replace("/", "_"), attr)
             desc["arrays"][attr] = {
                 "file": zname,

@@ -28,7 +28,10 @@ from .units import Unit
 class StatusRegistry:
     """Thread-safe workflow-status store with age-out."""
 
-    def __init__(self, gc_timeout=180.0):
+    def __init__(self, gc_timeout=3600.0):
+        # generous by default: reporters heartbeat once per EPOCH, and a
+        # real epoch can take many minutes — aging out a live workflow
+        # would invert the reference's dead-master GC intent
         self._lock = threading.Lock()
         self._entries = {}
         self.gc_timeout = gc_timeout
@@ -88,6 +91,8 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         try:
             payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("update body must be a JSON object")
             key = payload.pop("id", self.client_address[0])
             self.registry.update(key, payload)
             self._send(200, '{"ok": true}')
@@ -113,6 +118,11 @@ class StatusServer:
         self._thread.start()
 
     def stop(self):
+        """Release one reference; the socket closes when the last owner
+        (shared via :func:`serve`) lets go."""
+        self._refs = max(getattr(self, "_refs", 1) - 1, 0)
+        if self._refs:
+            return
         self._httpd.shutdown()
         self._httpd.server_close()
         _servers.pop(self.port, None)
@@ -122,12 +132,15 @@ _servers = {}
 
 
 def serve(port=0, registry=None):
-    """Start (or reuse) the status server on ``port`` — a second Launcher
-    in the same process must not crash with EADDRINUSE on the socket the
-    first one's daemon thread still holds."""
+    """Start (or reuse, refcounted) the status server on ``port`` — a
+    second Launcher in the same process must neither crash with
+    EADDRINUSE nor have its endpoint killed by the first one's stop()."""
     if port and port in _servers:
-        return _servers[port]
+        server = _servers[port]
+        server._refs = getattr(server, "_refs", 1) + 1
+        return server
     server = StatusServer(port, registry)
+    server._refs = 1
     _servers[server.port] = server
     return server
 

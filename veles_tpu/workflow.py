@@ -55,6 +55,16 @@ class Workflow(Container):
         if unit is self:
             raise ValueError("a workflow cannot contain itself")
         if unit not in self._units:
+            # unique member names: links, stats, and the export archive
+            # (per-unit .npy paths, package contents.json) are all keyed
+            # by name — two default-named Conv units must not collide
+            taken = {u.name for u in self._units}
+            if unit.name in taken:
+                base = unit.name
+                i = 1
+                while "%s.%d" % (base, i) in taken:
+                    i += 1
+                unit.name = "%s.%d" % (base, i)
             self._units.append(unit)
         unit.workflow = self
 

@@ -68,6 +68,11 @@ class All2All(ForwardBase):
         return y
 
 
+    def export_params(self):
+        return {"neurons": int(self.neurons_number),
+                "include_bias": bool(self.include_bias)}
+
+
 class All2AllTanh(All2All):
     """y = 1.7159 * tanh(0.6666 * (xW + b))."""
     MAPPING = "all2all_tanh"

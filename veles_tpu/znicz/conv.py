@@ -107,6 +107,14 @@ class Conv(ForwardBase):
         return self.activation.fwd_np(y)
 
 
+    def export_params(self):
+        return {"n_kernels": int(self.n_kernels), "kx": int(self.kx),
+                "ky": int(self.ky), "padding": list(self.padding),
+                "sliding": list(self.sliding),
+                "grouping": int(self.grouping),
+                "include_bias": bool(self.include_bias)}
+
+
 class ConvTanh(Conv):
     MAPPING = "conv_tanh"
     ACTIVATION = "tanh"

@@ -38,6 +38,10 @@ class DropoutForward(ParamlessForward):
         return x
 
 
+    def export_params(self):
+        return {"dropout_ratio": self.dropout_ratio}
+
+
 class DropoutBackward(GradientDescentBase):
     """Regenerates the forward's mask from its recorded key and routes the
     error through it.  Not jitted: the key changes every minibatch, so the

@@ -42,5 +42,10 @@ class LRNormalizerForward(ParamlessForward):
         return x / self._den(x * x, numpy)
 
 
+    def export_params(self):
+        return {"alpha": self.alpha, "beta": self.beta, "k": self.k,
+                "n": self.n}
+
+
 class LRNormalizerBackward(GenericVJPBackward):
     MAPPING = "norm"

@@ -46,6 +46,12 @@ class ForwardBase(NNUnitBase):
     #: the key ARRIVES AS AN ARGUMENT so jit never freezes the draw
     stochastic = False
 
+    def export_params(self):
+        """Structural hyperparameters for the package archive — what the
+        native engine needs to rebuild this unit (reference libVeles
+        Unit::SetParameter from contents.json, unit.h:87-92)."""
+        return {}
+
     def apply_train(self, params, x, key=None):
         """Train-time forward; defaults to the eval forward.  Stochastic
         units override and consume ``key``."""
@@ -82,7 +88,8 @@ class ForwardBase(NNUnitBase):
                                       kwargs.get("weights_stddev"))
         self.weights_filling = kwargs.get("weights_filling", "uniform")
         self.bias_filling = kwargs.get("bias_filling", "uniform")
-        self.exports = ["weights", "bias", "include_bias"]
+        # include_bias is structural config (export_params), not a tensor
+        self.exports = ["weights", "bias"]
 
     # -- parameter handling --------------------------------------------------
     @property

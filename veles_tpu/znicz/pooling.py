@@ -60,6 +60,12 @@ class PoolingBase(ParamlessForward):
     PAD_VALUE = 0.0
 
 
+    def export_params(self):
+        return {"kx": int(self.kx), "ky": int(self.ky),
+                "padding": list(self.padding),
+                "sliding": list(self.sliding)}
+
+
 class MaxPooling(PoolingBase):
     MAPPING = "max_pooling"
     PAD_VALUE = -numpy.inf
