@@ -29,6 +29,24 @@ std::unique_ptr<Unit> UnitRegistry::Create(
 
 namespace {
 
+void RequireRank(const Tensor& t, size_t rank, const char* what) {
+  if (t.shape.size() != rank)
+    throw std::runtime_error(std::string(what) + ": expected rank " +
+                             std::to_string(rank) + " input, got rank " +
+                             std::to_string(t.shape.size()));
+}
+
+// Output spatial dim with explicit validation (size_t arithmetic would
+// underflow when the kernel exceeds the padded input).
+size_t OutDim(size_t in, long pad_lo, long pad_hi, size_t k, long stride,
+              const char* what) {
+  long padded = static_cast<long>(in) + pad_lo + pad_hi;
+  if (padded < static_cast<long>(k))
+    throw std::runtime_error(std::string(what) +
+                             ": kernel larger than padded input");
+  return static_cast<size_t>((padded - static_cast<long>(k)) / stride + 1);
+}
+
 // ---------------------------------------------------------------------------
 // Activations (shared by All2All*/Conv* variants)
 
@@ -88,11 +106,18 @@ void ApplyActivation(Act act, Tensor* t) {
 
 class All2AllUnit : public Unit {
  public:
-  All2AllUnit(Act act, NpyArray weights, NpyArray bias, bool has_bias)
+  All2AllUnit(Act act, const Json& cfg, NpyArray weights, NpyArray bias,
+              bool has_bias)
       : act_(act), w_(std::move(weights)), b_(std::move(bias)),
-        has_bias_(has_bias) {}
+        has_bias_(has_bias) {
+    if (cfg.has("output_sample_shape"))
+      for (const Json& d : cfg["output_sample_shape"].array)
+        out_sample_shape_.push_back(static_cast<size_t>(d.number));
+  }
 
   void Run(const Tensor& in, Tensor* out) const override {
+    if (in.shape.empty())
+      throw std::runtime_error("all2all: rank-0 input");
     size_t batch = in.shape[0];
     size_t n_in = w_.shape[0], n_out = w_.shape[1];
     if (in.sample_size() != n_in)
@@ -115,12 +140,18 @@ class All2AllUnit : public Unit {
         for (size_t j = 0; j < n_out; ++j) yr[j] += b_.data[j];
     }
     ApplyActivation(act_, out);
+    if (!out_sample_shape_.empty()) {
+      // mirror the Python All2All's multi-dim output_sample_shape view
+      out->shape = {batch};
+      for (size_t d : out_sample_shape_) out->shape.push_back(d);
+    }
   }
 
  private:
   Act act_;
   NpyArray w_, b_;
   bool has_bias_;
+  std::vector<size_t> out_sample_shape_;
 };
 
 // ---------------------------------------------------------------------------
@@ -141,14 +172,17 @@ class ConvUnit : public Unit {
   }
 
   void Run(const Tensor& in, Tensor* out) const override {
+    RequireRank(in, 4, "conv");
     size_t batch = in.shape[0], h = in.shape[1], w = in.shape[2],
            c_in = in.shape[3];
     size_t ky = w_.shape[0], kx = w_.shape[1], c_g = w_.shape[2],
            n_k = w_.shape[3];
+    if (c_in != c_g * static_cast<size_t>(grouping_))
+      throw std::runtime_error("conv input channel mismatch");
     long pt = padding_[0], pb = padding_[1], pl = padding_[2],
          pr = padding_[3];
-    size_t oh = (h + pt + pb - ky) / sy_ + 1;
-    size_t ow = (w + pl + pr - kx) / sx_ + 1;
+    size_t oh = OutDim(h, pt, pb, ky, sy_, "conv");
+    size_t ow = OutDim(w, pl, pr, kx, sx_, "conv");
     size_t g = static_cast<size_t>(grouping_);
     size_t kpg = n_k / g;  // kernels per group
     out->shape = {batch, oh, ow, n_k};
@@ -210,12 +244,13 @@ class PoolUnit : public Unit {
   }
 
   void Run(const Tensor& in, Tensor* out) const override {
+    RequireRank(in, 4, "pooling");
     size_t batch = in.shape[0], h = in.shape[1], w = in.shape[2],
            c = in.shape[3];
     long pt = padding_[0], pb = padding_[1], pl = padding_[2],
          pr = padding_[3];
-    size_t oh = (h + pt + pb - ky_) / sy_ + 1;
-    size_t ow = (w + pl + pr - kx_) / sx_ + 1;
+    size_t oh = OutDim(h, pt, pb, ky_, sy_, "pooling");
+    size_t ow = OutDim(w, pl, pr, kx_, sx_, "pooling");
     out->shape = {batch, oh, ow, c};
     out->data.assign(batch * oh * ow * c,
                      is_max_ ? -3.4e38f : 0.0f);
@@ -336,7 +371,7 @@ bool RegisterBuiltins() {
       if (cfg.has("include_bias") && !cfg["include_bias"].boolean)
         has_bias = false;
       return std::unique_ptr<Unit>(new All2AllUnit(
-          ActivationFor(cls), std::move(w), std::move(b), has_bias));
+          ActivationFor(cls), cfg, std::move(w), std::move(b), has_bias));
     });
   }
   for (const char* cls : {"Conv", "ConvTanh", "ConvSigmoid", "ConvRELU",

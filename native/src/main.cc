@@ -11,23 +11,13 @@
 
 #include "engine.h"
 #include "npy.h"
+#include "zipreader.h"
 
 using veles_native::NpyArray;
 using veles_native::Tensor;
 using veles_native::Workflow;
 
 namespace {
-
-std::vector<uint8_t> read_file(const std::string& path) {
-  std::ifstream f(path, std::ios::binary);
-  if (!f) throw std::runtime_error("cannot open " + path);
-  f.seekg(0, std::ios::end);
-  std::vector<uint8_t> data(static_cast<size_t>(f.tellg()));
-  f.seekg(0);
-  f.read(reinterpret_cast<char*>(data.data()),
-         static_cast<std::streamsize>(data.size()));
-  return data;
-}
 
 void write_npy_f32(const std::string& path, const Tensor& t) {
   std::string shape;
@@ -70,7 +60,7 @@ int main(int argc, char** argv) {
       std::uniform_real_distribution<float> dist(-1.0f, 1.0f);
       for (float& v : in.data) v = dist(rng);
     } else {
-      NpyArray arr = veles_native::load_npy(read_file(argv[2]));
+      NpyArray arr = veles_native::load_npy(veles_native::ReadFile(argv[2]));
       in.shape = arr.shape;
       in.data = std::move(arr.data);
     }

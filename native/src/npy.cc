@@ -64,11 +64,14 @@ NpyArray load_npy(const std::vector<uint8_t>& bytes) {
     header_len = bytes[8] | (bytes[9] << 8);
     header_off = 10;
   } else {
+    if (bytes.size() < 12) throw std::runtime_error("npy header truncated");
     header_len = bytes[8] | (bytes[9] << 8) |
                  (static_cast<size_t>(bytes[10]) << 16) |
                  (static_cast<size_t>(bytes[11]) << 24);
     header_off = 12;
   }
+  if (header_off + header_len > bytes.size())
+    throw std::runtime_error("npy header truncated");
   std::string header(reinterpret_cast<const char*>(&bytes[header_off]),
                      header_len);
   std::string descr = header_field(header, "descr");

@@ -19,7 +19,9 @@ uint32_t rd32(const uint8_t* p) {
          (static_cast<uint32_t>(p[3]) << 24);
 }
 
-std::vector<uint8_t> read_file(const std::string& path) {
+}  // namespace
+
+std::vector<uint8_t> ReadFile(const std::string& path) {
   std::ifstream f(path, std::ios::binary);
   if (!f) throw std::runtime_error("cannot open " + path);
   f.seekg(0, std::ios::end);
@@ -27,13 +29,12 @@ std::vector<uint8_t> read_file(const std::string& path) {
   f.seekg(0);
   f.read(reinterpret_cast<char*>(data.data()),
          static_cast<std::streamsize>(data.size()));
+  if (!f) throw std::runtime_error("short read: " + path);
   return data;
 }
 
-}  // namespace
-
 ZipReader::ZipReader(const std::string& path) : path_(path) {
-  std::vector<uint8_t> data = read_file(path);
+  std::vector<uint8_t> data = ReadFile(path);
   // find End Of Central Directory (EOCD) signature scanning backwards
   const uint32_t kEOCD = 0x06054b50, kCDIR = 0x02014b50;
   if (data.size() < 22) throw std::runtime_error("not a zip: " + path);

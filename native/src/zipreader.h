@@ -13,6 +13,9 @@
 
 namespace veles_native {
 
+// Slurp a file (shared by the zip reader and the CLI npy loader).
+std::vector<uint8_t> ReadFile(const std::string& path);
+
 class ZipReader {
  public:
   explicit ZipReader(const std::string& path);

@@ -1,0 +1,127 @@
+"""Service units: REST inference, publisher, downloader, shell, stream
+loader (SURVEY §2.8 leftovers)."""
+
+import json
+import os
+import tarfile
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.downloader import Downloader
+from veles_tpu.interaction import Shell
+from veles_tpu.loader.stream import StreamLoader
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.publishing import Publisher
+from veles_tpu.restful_api import RESTfulAPI
+from veles_tpu.workflow import Workflow
+from veles_tpu.znicz.samples import mnist
+
+
+@pytest.fixture(scope="module")
+def trained():
+    wf = mnist.create_workflow(
+        loader={"minibatch_size": 100, "n_train": 400, "n_valid": 100,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 2, "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    wf.run()
+    return wf
+
+
+def test_rest_api_live_workflow(trained):
+    api = RESTfulAPI(trained, port=0)
+    try:
+        x = numpy.asarray(
+            trained.loader.original_data.map_read()[:3]).tolist()
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/api" % api.port,
+            json.dumps({"input": x}).encode(),
+            {"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req).read())
+        assert len(resp["result"]) == 3
+        assert all(0 <= r < 10 for r in resp["result"])
+        assert numpy.asarray(resp["output"]).shape == (3, 10)
+        # malformed request → JSON error, not a dropped connection
+        bad = urllib.request.Request(
+            "http://127.0.0.1:%d/api" % api.port, b"[1,2]",
+            {"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(bad)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "error" in json.loads(e.read())
+    finally:
+        api.stop()
+
+
+def test_rest_api_from_package(trained, tmp_path):
+    from veles_tpu.export import export_model
+    path = str(tmp_path / "pkg.zip")
+    export_model(trained, path)
+    api = RESTfulAPI(path, port=0)
+    try:
+        x = numpy.asarray(
+            trained.loader.original_data.map_read()[0]).tolist()
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/api" % api.port,
+            json.dumps({"input": x}).encode(),
+            {"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req).read())
+        assert len(resp["result"]) == 1  # 1-sample convenience
+    finally:
+        api.stop()
+
+
+def test_publisher(trained, tmp_path):
+    pub = Publisher(trained, directory=str(tmp_path),
+                    backends=("markdown", "json"))
+    pub.link_decision(trained.decision)
+    pub.run()
+    md = open(os.path.join(str(tmp_path), "report.md")).read()
+    assert "MnistSimple" in md and "best_validation_error_pt" in md
+    report = json.load(open(os.path.join(str(tmp_path), "report.json")))
+    assert report["workflow"] == "MnistSimple"
+    assert any(u["runs"] > 0 for u in report["units"])
+
+
+def test_downloader_local_archive(tmp_path):
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    (src_dir / "data.txt").write_text("payload")
+    archive = str(tmp_path / "ds.tar.gz")
+    with tarfile.open(archive, "w:gz") as tf:
+        tf.add(str(src_dir / "data.txt"), arcname="data.txt")
+    dest = str(tmp_path / "dest")
+    wf = Workflow(None)
+    d = Downloader(wf, url=archive, directory=dest, files=["data.txt"])
+    d.initialize()
+    assert open(os.path.join(dest, "data.txt")).read() == "payload"
+    # second initialize: files present → no re-fetch needed
+    d2 = Downloader(wf, url="/nonexistent", directory=dest,
+                    files=["data.txt"])
+    d2.initialize()
+
+
+def test_shell_noop_by_default():
+    wf = Workflow(None)
+    Shell(wf).run()  # interactive=False → returns immediately
+
+
+def test_stream_loader_serves_pushed_batches(trained):
+    wf = Workflow(None)
+    ld = StreamLoader(wf, minibatch_size=4, sample_shape=(784,),
+                      timeout=5)
+    ld.initialize(device=Device(backend="auto"))
+    batch = numpy.asarray(trained.loader.original_data.map_read()[:4])
+    ld.feed(batch)
+    ld.run()
+    assert int(ld.minibatch_size) == 4
+    got = numpy.asarray(ld.minibatch_data.map_read()[:4])
+    assert numpy.allclose(got, batch)
+    ld.close()
+    ld.run()
+    assert ld.finished

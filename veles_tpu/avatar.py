@@ -1,0 +1,45 @@
+"""Avatar: decoupled copies of another unit's output attributes.
+
+Re-creation of /root/reference/veles/avatar.py:84: multi-consumer graphs
+sometimes need a frozen copy of the loader's minibatch (e.g. one branch
+mutates/normalizes while another needs the original).  ``clone()``
+registers which attributes to copy; each run snapshots them into this
+unit's own Arrays.
+"""
+
+import numpy
+
+from .memory import Array
+from .units import Unit
+
+
+class Avatar(Unit):
+    MAPPING = "avatar"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self._cloned = []
+
+    def clone(self, unit, *attrs):
+        """Copy ``unit.<attr>`` into ``self.<attr>`` on every run."""
+        for attr in attrs:
+            # no leading underscore: linked attrs resolve through
+            # the Unit attribute machinery, which bypasses _-names
+            self.link_attrs(unit, ("src_%s" % attr, attr))
+            setattr(self, attr, Array())
+            self._cloned.append(attr)
+        return self
+
+    def run(self):
+        for attr in self._cloned:
+            src = getattr(self, "src_%s" % attr)
+            dst = getattr(self, attr)
+            if isinstance(src, Array):
+                if src.devmem is not None:
+                    # device-side copy: one fused kernel, no host trip
+                    import jax.numpy as jnp
+                    dst.devmem = jnp.array(src.devmem)
+                else:
+                    dst.mem = numpy.array(src.map_read())
+            else:
+                setattr(self, attr, numpy.copy(src))

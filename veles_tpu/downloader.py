@@ -1,0 +1,69 @@
+"""Downloader: fetch + unpack a dataset at workflow initialize.
+
+Re-creation of /root/reference/veles/downloader.py (:56,125): the unit
+downloads ``url`` into the datasets directory and unpacks tar/zip
+archives before the loader touches ``directory``.  Local ``file://``
+URLs and plain paths are first-class (this build runs in zero-egress
+environments; HTTP still works where the network allows it).
+"""
+
+import os
+import shutil
+import tarfile
+import urllib.parse
+import urllib.request
+import zipfile
+
+from .config import root
+from .units import Unit
+
+
+class Downloader(Unit):
+    MAPPING = "downloader"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.url = kwargs.get("url")
+        self.directory = kwargs.get("directory") or \
+            root.common.dirs.get("datasets", ".")
+        # files whose presence means the dataset is already there
+        self.files = list(kwargs.get("files", ()))
+
+    @property
+    def ready(self):
+        return self.files and all(
+            os.path.exists(os.path.join(self.directory, f))
+            for f in self.files)
+
+    def initialize(self, **kwargs):
+        super().initialize(**kwargs)
+        if self.ready:
+            return
+        if not self.url:
+            raise ValueError("dataset files missing and no url given")
+        self.fetch()
+
+    def fetch(self):
+        os.makedirs(self.directory, exist_ok=True)
+        parsed = urllib.parse.urlparse(str(self.url))
+        name = os.path.basename(parsed.path) or "download"
+        target = os.path.join(self.directory, name)
+        if parsed.scheme in ("", "file"):
+            src = parsed.path if parsed.scheme == "file" else self.url
+            shutil.copy(src, target)
+        else:
+            urllib.request.urlretrieve(self.url, target)
+        self.unpack(target)
+        return target
+
+    def unpack(self, path):
+        if tarfile.is_tarfile(path):
+            with tarfile.open(path) as tf:
+                tf.extractall(self.directory, filter="data")
+        elif zipfile.is_zipfile(path):
+            with zipfile.ZipFile(path) as zf:
+                zf.extractall(self.directory)
+
+    def run(self):
+        pass  # all the work happens at initialize, like the reference

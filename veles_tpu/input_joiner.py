@@ -1,0 +1,58 @@
+"""InputJoiner: concatenate N input Arrays along the feature axis.
+
+Re-creation of /root/reference/veles/input_joiner.py:49 (+ the templated
+``join`` kernel, ocl/join.jcl): the reference generated an OpenCL kernel
+per input count; here one jitted ``jnp.concatenate`` covers every case
+and XLA fuses it with the producers.
+"""
+
+import numpy
+
+from .memory import Array
+from .units import Unit
+
+
+class InputJoiner(Unit):
+    """``output = concat(inputs..., axis=-1)`` on device.
+
+    Link inputs with ``link_inputs(unit_a, "output", unit_b, "output")``
+    or assign ``input_<i>`` attributes directly."""
+
+    MAPPING = "input_joiner"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.output = Array()
+        self.num_inputs = 0
+
+    def link_inputs(self, *unit_attr_pairs):
+        """(unit, attr) pairs in join order."""
+        for unit, attr in unit_attr_pairs:
+            name = "input_%d" % self.num_inputs
+            self.link_attrs(unit, (name, attr))
+            self.num_inputs += 1
+        return self
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(**kwargs)
+        self.device = device
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def join(inputs):
+            flat = [x.reshape(x.shape[0], -1) for x in inputs]
+            return jnp.concatenate(flat, axis=-1)
+        self._join_ = join
+
+    def _value(self, i):
+        v = getattr(self, "input_%d" % i)
+        return v.devmem if isinstance(v, Array) else v
+
+    def run(self):
+        inputs = [self._value(i) for i in range(self.num_inputs)]
+        if self.device is not None and self.device.exists:
+            self.output.devmem = self._join_(tuple(inputs))
+        else:
+            flat = [numpy.asarray(x).reshape(len(x), -1) for x in inputs]
+            self.output.mem = numpy.concatenate(flat, axis=-1)

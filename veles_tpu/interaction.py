@@ -1,0 +1,34 @@
+"""Shell unit: drop into an interactive console mid-workflow.
+
+Re-creation of /root/reference/veles/interaction.py (:49): the reference
+embedded an IPython kernel; here the unit prefers IPython when present
+and falls back to the stdlib ``code.interact``, with the workflow and
+unit namespace exposed.  ``interactive=False`` (the default under tests
+and batch runs) makes it a no-op so graphs can keep the unit wired
+permanently.
+"""
+
+from .units import Unit
+
+
+class Shell(Unit):
+    MAPPING = "shell"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.interactive = bool(kwargs.get("interactive", False))
+        self.banner = kwargs.get(
+            "banner", "veles_tpu shell — `workflow` and `shell` are in "
+                      "scope; exit to resume the graph")
+
+    def run(self):
+        if not self.interactive:
+            return
+        ns = {"workflow": self._workflow, "shell": self}
+        try:
+            import IPython
+            IPython.embed(user_ns=ns, banner1=self.banner)
+        except ImportError:
+            import code
+            code.interact(banner=self.banner, local=ns)

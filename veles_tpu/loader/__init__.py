@@ -6,3 +6,7 @@ Reference: /root/reference/veles/loader/ (base protocol at base.py:100-120).
 from .base import (Loader, LoaderError, TEST, VALID, TRAIN, CLASS_NAME,
                    TRIAGE)                                  # noqa: F401
 from .fullbatch import FullBatchLoader, FullBatchLoaderMSE  # noqa: F401
+from .image import ImageLoader, FileImageLoader  # noqa: F401
+from .pickles import (PicklesLoader, Hdf5Loader,            # noqa: F401
+                      FileListLoader)
+from .saver import MinibatchesSaver, MinibatchesLoader      # noqa: F401

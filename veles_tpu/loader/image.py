@@ -1,0 +1,169 @@
+"""Image pipeline: decode/resize/augment images into the HBM fullbatch.
+
+TPU-native re-design of /root/reference/veles/loader/image.py (~1300 LoC
+of per-minibatch PIL work) + fullbatch_image.py.  The reference decoded
+and transformed images per minibatch on the host; on TPU the host would
+then fight the device for the input pipeline, so the design decodes and
+augments ONCE at initialize into the resident FullBatch dataset (HBM),
+and the per-step path stays a fused device gather.  The capability
+surface kept: scale (factor or fixed target, aspect-preserving with
+background fill), center crop, horizontal mirror expansion, grayscale/
+RGB channel handling, background color, and the
+``get_keys``/``get_image_data``/``get_image_label`` subclass protocol
+(reference IImageLoader, image.py:83-104).
+"""
+
+import os
+
+import numpy
+
+from .base import TEST, VALID, TRAIN
+from .fullbatch import FullBatchLoader
+
+
+class ImageLoader(FullBatchLoader):
+    """FullBatch loader whose samples come from decoded images.
+
+    kwargs:
+      scale: float factor or (height, width) target size;
+      maintain_aspect: letterbox into the target with background fill
+        (reference scale_maintain_aspect_ratio);
+      crop: (height, width) center crop after scaling;
+      mirror: False | True — True EXPANDS the train set with horizontally
+        flipped copies (the static-dataset equivalent of the reference's
+        per-epoch "random" mirror);
+      grayscale: collapse to one channel;
+      background_color: RGB fill for letterboxing.
+    """
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.scale = kwargs.get("scale", 1.0)
+        self.maintain_aspect = bool(kwargs.get("maintain_aspect", True))
+        self.crop = kwargs.get("crop")
+        self.mirror = kwargs.get("mirror", False)
+        self.grayscale = bool(kwargs.get("grayscale", False))
+        self.background_color = tuple(
+            kwargs.get("background_color", (0, 0, 0)))
+
+    # -- subclass protocol (reference IImageLoader) --------------------------
+    def get_keys(self, class_index):
+        """Image keys (e.g. paths) for TEST/VALID/TRAIN."""
+        raise NotImplementedError
+
+    def get_image_label(self, key):
+        raise NotImplementedError
+
+    def get_image_data(self, key):
+        """Decode one image to an HxWxC uint8/float array."""
+        from PIL import Image
+        with Image.open(key) as img:
+            return numpy.asarray(img.convert(
+                "L" if self.grayscale else "RGB"))
+
+    # -- transforms ----------------------------------------------------------
+    def transform_image(self, data):
+        """scale → crop → channel handling; returns float32 HxWxC."""
+        from PIL import Image
+        if data.ndim == 2:
+            data = data[:, :, None]
+        img = data
+        if self.scale != 1.0:
+            if isinstance(self.scale, (tuple, list)):
+                th, tw = self.scale
+            else:
+                th = int(round(img.shape[0] * self.scale))
+                tw = int(round(img.shape[1] * self.scale))
+            pil = Image.fromarray(img.squeeze(-1) if img.shape[-1] == 1
+                                  else img)
+            if self.maintain_aspect:
+                ratio = min(th / img.shape[0], tw / img.shape[1])
+                nh = max(1, int(round(img.shape[0] * ratio)))
+                nw = max(1, int(round(img.shape[1] * ratio)))
+                pil = pil.resize((nw, nh), Image.BILINEAR)
+                bg = self.background_color
+                canvas = Image.new(
+                    pil.mode, (tw, th),
+                    bg[0] if pil.mode == "L" else bg)
+                canvas.paste(pil, ((tw - nw) // 2, (th - nh) // 2))
+                pil = canvas
+            else:
+                pil = pil.resize((tw, th), Image.BILINEAR)
+            img = numpy.asarray(pil)
+            if img.ndim == 2:
+                img = img[:, :, None]
+        if self.crop is not None:
+            ch, cw = self.crop
+            oy = max((img.shape[0] - ch) // 2, 0)
+            ox = max((img.shape[1] - cw) // 2, 0)
+            img = img[oy:oy + ch, ox:ox + cw]
+        return numpy.asarray(img, numpy.float32)
+
+    # -- FullBatch integration -----------------------------------------------
+    def load_data(self):
+        data_per_class = {}
+        labels_per_class = {}
+        for cls in (TEST, VALID, TRAIN):
+            keys = list(self.get_keys(cls))
+            samples, labels = [], []
+            for key in keys:
+                samples.append(self.transform_image(
+                    self.get_image_data(key)))
+                labels.append(self.get_image_label(key))
+            if cls == TRAIN and self.mirror and samples:
+                samples += [s[:, ::-1].copy() for s in samples]
+                labels += list(labels)
+            data_per_class[cls] = samples
+            labels_per_class[cls] = labels
+        all_samples = (data_per_class[TEST] + data_per_class[VALID] +
+                       data_per_class[TRAIN])
+        if not all_samples:
+            raise ValueError("no images found by get_keys")
+        shapes = {s.shape for s in all_samples}
+        if len(shapes) != 1:
+            raise ValueError(
+                "images produce differing sample shapes %s — set scale=(h, "
+                "w) or crop to normalize them" % sorted(shapes))
+        self.original_data.mem = numpy.stack(all_samples)
+        self.original_labels = (labels_per_class[TEST] +
+                                labels_per_class[VALID] +
+                                labels_per_class[TRAIN])
+        for cls in (TEST, VALID, TRAIN):
+            self.class_lengths[cls] = len(data_per_class[cls])
+
+
+class FileImageLoader(ImageLoader):
+    """Directory-tree image loader: labels from subdirectory names.
+
+    (reference file_image.py / FileListImageLoader role.)
+
+    kwargs ``test_paths``/``validation_paths``/``train_paths``: lists of
+    directories whose immediate subdirectories name the labels, e.g.
+    ``train/cat/1.png``; flat directories label every file with the
+    directory's own basename."""
+
+    MAPPING = "file_image_loader"
+    EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".gif")
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.class_paths = {
+            TEST: list(kwargs.get("test_paths", ())),
+            VALID: list(kwargs.get("validation_paths", ())),
+            TRAIN: list(kwargs.get("train_paths", ())),
+        }
+
+    def get_keys(self, class_index):
+        keys = []
+        for base in self.class_paths[class_index]:
+            for dirpath, _dirs, files in sorted(os.walk(base)):
+                for fname in sorted(files):
+                    if os.path.splitext(fname)[1].lower() in \
+                            self.EXTENSIONS:
+                        keys.append(os.path.join(dirpath, fname))
+        return keys
+
+    def get_image_label(self, key):
+        return os.path.basename(os.path.dirname(key))

@@ -1,0 +1,139 @@
+"""Pickle / HDF5 / file-list loaders (reference veles/loader/pickles.py,
+loader_hdf5.py, file_loader.py).
+
+All feed the same HBM-resident FullBatch pipeline: host-side reading at
+initialize, device gather per step.
+"""
+
+import os
+import pickle
+
+import numpy
+
+from .base import TEST, VALID, TRAIN
+from .fullbatch import FullBatchLoader
+
+
+def _split_payload(payload):
+    """(data, labels) from a pickle payload: tuple/list pair or a dict
+    with data/labels keys."""
+    if isinstance(payload, dict):
+        return payload["data"], payload.get("labels")
+    if isinstance(payload, (tuple, list)) and len(payload) == 2:
+        return payload[0], payload[1]
+    return payload, None
+
+
+class PicklesLoader(FullBatchLoader):
+    """Datasets from per-class pickle files (reference pickles.py).
+
+    kwargs ``test_path``/``validation_path``/``train_path``: each a
+    pickle of ``(data, labels)`` or ``{"data": ..., "labels": ...}``."""
+
+    MAPPING = "pickles_loader"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.paths = {TEST: kwargs.get("test_path"),
+                      VALID: kwargs.get("validation_path"),
+                      TRAIN: kwargs.get("train_path")}
+
+    def load_class(self, cls):
+        path = self.paths[cls]
+        if not path:
+            return None, None
+        with open(path, "rb") as f:
+            return _split_payload(pickle.load(f))
+
+    def load_data(self):
+        chunks, labels = [], []
+        for cls in (TEST, VALID, TRAIN):
+            data, lab = self.load_class(cls)
+            n = 0 if data is None else len(data)
+            self.class_lengths[cls] = n
+            if n:
+                chunks.append(numpy.asarray(data, numpy.float32))
+                if lab is not None:
+                    labels.extend(list(lab))
+        if not chunks:
+            raise ValueError("no class path produced data")
+        self.original_data.mem = numpy.concatenate(chunks)
+        if labels:
+            if len(labels) != len(self.original_data.mem):
+                raise ValueError("labels/data length mismatch")
+            self.original_labels = labels
+        else:
+            self.has_labels = False
+
+
+class Hdf5Loader(FullBatchLoader):
+    """Datasets from HDF5 files (reference loader_hdf5.py).
+
+    kwargs ``test_path``/``validation_path``/``train_path``; dataset
+    names via ``data_name``/``labels_name`` (default "data"/"labels")."""
+
+    MAPPING = "hdf5_loader"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.paths = {TEST: kwargs.get("test_path"),
+                      VALID: kwargs.get("validation_path"),
+                      TRAIN: kwargs.get("train_path")}
+        self.data_name = kwargs.get("data_name", "data")
+        self.labels_name = kwargs.get("labels_name", "labels")
+
+    def load_data(self):
+        import h5py
+        chunks, labels = [], []
+        for cls in (TEST, VALID, TRAIN):
+            path = self.paths[cls]
+            if not path:
+                self.class_lengths[cls] = 0
+                continue
+            with h5py.File(path, "r") as f:
+                data = numpy.asarray(f[self.data_name], numpy.float32)
+                self.class_lengths[cls] = len(data)
+                chunks.append(data)
+                if self.labels_name in f:
+                    labels.extend(numpy.asarray(f[self.labels_name])
+                                  .tolist())
+        if not chunks:
+            raise ValueError("no class path produced data")
+        self.original_data.mem = numpy.concatenate(chunks)
+        if labels:
+            if len(labels) != len(self.original_data.mem):
+                raise ValueError(
+                    "labels/data length mismatch: some class files carry "
+                    "a %r dataset and others do not" % self.labels_name)
+            self.original_labels = labels
+        else:
+            self.has_labels = False
+
+
+class FileListLoader(FullBatchLoader):
+    """Numeric-array files listed per class (reference file_loader.py):
+    each file is one ``.npy`` sample (or a batch when ``batched``)."""
+
+    MAPPING = "file_list_loader"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.file_lists = {TEST: list(kwargs.get("test_files", ())),
+                           VALID: list(kwargs.get("validation_files", ())),
+                           TRAIN: list(kwargs.get("train_files", ()))}
+        self.label_from = kwargs.get(
+            "label_from", lambda path: os.path.basename(
+                os.path.dirname(path)))
+
+    def load_data(self):
+        samples, labels = [], []
+        for cls in (TEST, VALID, TRAIN):
+            files = self.file_lists[cls]
+            self.class_lengths[cls] = len(files)
+            for path in files:
+                samples.append(numpy.load(path).astype(numpy.float32))
+                labels.append(self.label_from(path))
+        if not samples:
+            raise ValueError("no files listed")
+        self.original_data.mem = numpy.stack(samples)
+        self.original_labels = labels

@@ -1,0 +1,111 @@
+"""MinibatchesSaver / MinibatchesLoader: materialized minibatch cache.
+
+Re-creation of /root/reference/veles/loader/saver.py: a unit that
+records every served minibatch into one pickle stream file, and a Loader
+that replays the file — used to freeze an expensive input pipeline
+(image decoding, augmentation) into a flat cache.
+"""
+
+import pickle
+
+import numpy
+
+from ..units import Unit
+from .base import Loader, TEST, VALID, TRAIN
+
+
+class MinibatchesSaver(Unit):
+    """Streams (class, size, data, labels) records to ``path``."""
+
+    MAPPING = "minibatches_saver"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.path = kwargs.get("path", "minibatches.pickle")
+        self.minibatch_data = None      # linked from loader
+        self.minibatch_labels = None
+        self.minibatch_size = None
+        self.minibatch_class = None
+        self._file_ = None
+
+    def link_loader(self, loader):
+        self.link_attrs(loader, "minibatch_data", "minibatch_labels",
+                        "minibatch_size", "minibatch_class")
+        return self
+
+    def run(self):
+        if self._file_ is None:
+            self._file_ = open(self.path, "wb")
+        size = int(self.minibatch_size)
+        data = numpy.asarray(self.minibatch_data.map_read()[:size])
+        labels = None
+        if self.minibatch_labels:
+            labels = numpy.asarray(
+                self.minibatch_labels.map_read()[:size])
+        pickle.dump((int(self.minibatch_class), size, data, labels),
+                    self._file_, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def close(self):
+        if self._file_ is not None:
+            self._file_.close()
+            self._file_ = None
+
+
+class MinibatchesLoader(Loader):
+    """Replays a MinibatchesSaver file through the Loader protocol.
+
+    The records are concatenated per class into a resident dataset, so
+    shuffling/requeueing behave exactly like any other loader."""
+
+    MAPPING = "minibatches_loader"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.path = kwargs.get("path", "minibatches.pickle")
+        self._data = None
+        self._labels = None
+
+    def load_data(self):
+        per_class = {TEST: [], VALID: [], TRAIN: []}
+        per_class_labels = {TEST: [], VALID: [], TRAIN: []}
+        with open(self.path, "rb") as f:
+            while True:
+                try:
+                    cls, size, data, labels = pickle.load(f)
+                except EOFError:
+                    break
+                per_class[cls].append(data[:size])
+                if labels is not None:
+                    per_class_labels[cls].extend(labels[:size].tolist())
+        chunks, labels = [], []
+        for cls in (TEST, VALID, TRAIN):
+            n = sum(len(c) for c in per_class[cls])
+            self.class_lengths[cls] = n
+            if n:
+                chunks.append(numpy.concatenate(per_class[cls]))
+                labels.extend(per_class_labels[cls])
+        if not chunks:
+            raise ValueError("no minibatch records in %s" % self.path)
+        self._data = numpy.concatenate(chunks)
+        if labels and len(labels) != len(self._data):
+            # mixed labelled/unlabelled records would silently shift
+            # every label onto the wrong sample
+            raise ValueError(
+                "minibatch cache mixes labelled and unlabelled records "
+                "(%d labels for %d samples)" % (len(labels),
+                                                len(self._data)))
+        self._labels = labels
+        self.has_labels = bool(labels)
+
+    def create_minibatch_data(self):
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + self._data.shape[1:],
+            numpy.float32))
+
+    def fill_minibatch(self):
+        idx = self.minibatch_indices.map_read()[:self.minibatch_size]
+        self.minibatch_data.map_write()[:self.minibatch_size] = \
+            self._data[idx]
+        if self.has_labels:
+            for i, sample_idx in enumerate(idx):
+                self.raw_minibatch_labels[i] = self._labels[sample_idx]

@@ -1,0 +1,84 @@
+"""StreamLoader: feed a running workflow from an external queue.
+
+Re-creation of /root/reference/veles/zmq_loader.py (:74): the reference
+fed a *trained, running* workflow from an external ZeroMQ queue (the
+serving input path).  The TPU-native equivalent is transport-agnostic: a
+thread-safe ``queue.Queue`` that any producer (the REST API, a socket
+reader, test code) pushes ``(data, labels)`` batches into; the loader
+blocks on it per run and serves each batch as one TEST-class minibatch.
+"""
+
+import queue
+
+import numpy
+
+from ..memory import Array
+from .base import Loader, TEST
+
+
+class StreamLoader(Loader):
+    """Serves externally-pushed batches (TEST class, no epochs)."""
+
+    MAPPING = "stream_loader"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.queue = kwargs.get("queue") or queue.Queue(
+            maxsize=int(kwargs.get("maxsize", 64)))
+        self.timeout = kwargs.get("timeout")  # None = block forever
+        self.sample_shape = tuple(kwargs.get("sample_shape", ()))
+        self.finished = False
+
+    def feed(self, data, labels=None):
+        """Producer side: enqueue one batch."""
+        self.queue.put((numpy.asarray(data, numpy.float32), labels))
+
+    def close(self):
+        """Producer side: no more batches — the next run() stops the
+        workflow's loop."""
+        self.queue.put(None)
+
+    # -- Loader protocol overrides -------------------------------------------
+    def load_data(self):
+        if not self.sample_shape:
+            raise ValueError("StreamLoader needs sample_shape=")
+        # a nominal single-class length: real serving is unbounded
+        self.class_lengths[TEST] = int(1e9)
+        self.has_labels = False
+
+    def create_minibatch_data(self):
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + self.sample_shape,
+            numpy.float32))
+
+    def analyze_dataset(self):
+        pass  # no resident data to analyze
+
+    def shuffle(self):
+        pass
+
+    def run(self):
+        try:
+            item = self.queue.get(timeout=self.timeout)
+        except queue.Empty:
+            item = None
+        if item is None:
+            self.finished = True
+            self.stopped = True
+            if self._workflow is not None:
+                self._workflow.stop()
+            return
+        data, labels = item
+        n = len(data)
+        if n > self.max_minibatch_size:
+            raise ValueError("batch of %d exceeds minibatch_size %d" %
+                             (n, self.max_minibatch_size))
+        self.minibatch_size = n
+        self.minibatch_class = TEST
+        mem = self.minibatch_data.map_write()
+        mem[:n] = data.reshape((n,) + self.sample_shape)
+        if n < self.max_minibatch_size:
+            mem[n:] = 0
+        if labels is not None:
+            self.minibatch_labels = Array(numpy.asarray(labels))
+        self.samples_served += n

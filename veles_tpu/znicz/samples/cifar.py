@@ -127,7 +127,7 @@ def create_workflow(fused=True, **overrides):
         overrides["snapshotter"] = cfg.snapshotter.todict()
     return StandardWorkflow(
         None, name="CifarConvnet",
-        loader_factory=CifarLoader,
+        loader_factory=overrides.pop("loader_factory", CifarLoader),
         loader=loader, layers=layers,
         loss_function="softmax", decision=decision, fused=fused,
         **overrides)

@@ -64,7 +64,7 @@ def create_workflow(fused=True, **overrides):
     return StandardWorkflow(
         None,
         name="MnistSimple",
-        loader_factory=MnistLoader,
+        loader_factory=overrides.pop("loader_factory", MnistLoader),
         loader=loader,
         layers=layers,
         loss_function="softmax",

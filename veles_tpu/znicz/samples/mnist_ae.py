@@ -71,7 +71,7 @@ def create_workflow(fused=True, **overrides):
     return StandardWorkflow(
         None,
         name="MnistAE",
-        loader_factory=MnistAELoader,
+        loader_factory=overrides.pop("loader_factory", MnistAELoader),
         loader=loader,
         layers=layers,
         loss_function="mse",
