@@ -141,6 +141,11 @@ class All2AllUnit : public Unit {
     }
     ApplyActivation(act_, out);
     if (!out_sample_shape_.empty()) {
+      size_t prod = 1;
+      for (size_t d : out_sample_shape_) prod *= d;
+      if (prod != n_out)
+        throw std::runtime_error(
+            "all2all output_sample_shape does not match weight width");
       // mirror the Python All2All's multi-dim output_sample_shape view
       out->shape = {batch};
       for (size_t d : out_sample_shape_) out->shape.push_back(d);

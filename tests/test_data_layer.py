@@ -153,5 +153,13 @@ def test_minibatch_cache_round_trip(tmp_path):
     ld.initialize(device=Device(backend="auto"))
     assert ld.class_lengths[TRAIN] == 200
     assert ld.class_lengths[VALID] == 50
+    # the replayed CONTENT must match the original dataset (an all-zero
+    # cache once passed the shape-only checks)
+    orig = numpy.sort(
+        numpy.asarray(wf.loader.original_data.map_read()), axis=None)
+    replay = numpy.sort(
+        numpy.asarray(ld.original_data.map_read()), axis=None)
+    assert numpy.allclose(orig, replay)
+    assert sorted(ld.labels_mapping) == sorted(wf.loader.labels_mapping)
     ld.run()
     assert ld.minibatch_data.map_read().shape[1:] == (784,)

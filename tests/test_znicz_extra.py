@@ -1,0 +1,174 @@
+"""Extra znicz units: lr adjusters, rollback, image saver, RBM, RNN/LSTM
+(SURVEY §2.9 leftovers)."""
+
+import os
+
+import numpy
+
+from veles_tpu.backends import Device
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.workflow import Workflow
+from veles_tpu.znicz.samples import mnist
+
+
+def _wf(**kw):
+    return mnist.create_workflow(
+        loader={"minibatch_size": 100, "n_train": 300, "n_valid": 100,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 3, "silent": True}, **kw)
+
+
+def test_lr_adjuster_policies():
+    from veles_tpu.znicz.lr_adjust import make_policy
+    assert make_policy("exp", gamma=0.5)(2) == 0.25
+    assert make_policy("step", gamma=0.1, step=10)(25) == \
+        numpy.float64(0.1) ** 2
+    assert abs(make_policy("inv", gamma=1.0, power=1.0)(3) - 0.25) < 1e-12
+    arb = make_policy("arbitrary", points=[(0, 1.0), (2, 0.5), (5, 0.1)])
+    assert arb(1) == 1.0 and arb(3) == 0.5 and arb(7) == 0.1
+
+
+def test_lr_adjuster_drives_fused_scale():
+    from veles_tpu.znicz.lr_adjust import LearningRateAdjuster
+    wf = _wf()
+    adj = LearningRateAdjuster(wf, policy="exp", gamma=0.5)
+    adj.link_from(wf.decision)
+    adj.link_loader(wf.loader)
+    adj.link_fused(wf.fused_step)
+    wf.initialize(device=Device(backend="auto"))
+    wf.run()
+    # the last adjustment happens at the end of epoch 1 (the end-of-
+    # epoch-2 run is skipped — training is over); scale_for(2) = 0.25
+    assert abs(wf.fused_step.lr_scale - 0.5 ** 2) < 1e-12
+
+
+def test_rollback_restores_best():
+    from veles_tpu.znicz.rollback import WeightsRollback
+    wf = _wf()
+    rb = WeightsRollback(wf, improvement_limit=1, lr_damping=0.5)
+    rb.link_from(wf.decision)
+    rb.link_all(wf.fused_step, wf.decision, wf.loader)
+    wf.initialize(device=Device(backend="auto"))
+    wf.run()
+    # training a tiny model 3 epochs always improves at least once
+    assert rb._best_params_ is not None
+
+
+def test_image_saver(tmp_path):
+    from veles_tpu.znicz.image_saver import ImageSaver
+    wf = _wf()
+    saver = ImageSaver(wf, directory=str(tmp_path), limit=8,
+                       sample_shape=(28, 28))
+    saver.link_all(wf.fused_step, wf.loader)
+    saver.link_from(wf.fused_step)
+    wf.initialize(device=Device(backend="auto"))
+    wf.run()
+    # early epochs misclassify plenty of validation samples
+    assert saver.saved > 0
+    pngs = []
+    for _r, _d, files in os.walk(str(tmp_path)):
+        pngs.extend(os.path.join(_r, f) for f in files)
+    assert len(pngs) == saver.saved
+    # a real (non-black) image was saved: the loader materialized the
+    # deferred minibatch before the saver read it
+    from PIL import Image
+    img = numpy.asarray(Image.open(pngs[0]))
+    assert img.std() > 0, "saved image is blank"
+
+
+def test_rbm_reconstruction_improves():
+    from veles_tpu.loader.base import TEST, VALID, TRAIN
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.plumbing import Repeater
+    from veles_tpu.znicz.rbm import RBMTrainer
+
+    class BinaryLoader(FullBatchLoader):
+        MAPPING = "rbm_test_loader"
+
+        def load_data(self):
+            rng = numpy.random.RandomState(5)
+            # binary patterns with structure: 8 prototypes + noise
+            protos = (rng.rand(8, 64) > 0.5).astype(numpy.float32)
+            idx = rng.randint(0, 8, 600)
+            data = protos[idx]
+            flip = rng.rand(*data.shape) < 0.05
+            data[flip] = 1.0 - data[flip]
+            self.original_data.mem = data
+            self.class_lengths[TEST] = 0
+            self.class_lengths[VALID] = 0
+            self.class_lengths[TRAIN] = 600
+            self.has_labels = False
+
+    wf = Workflow(None)
+    rep = Repeater(wf)
+    rep.link_from(wf.start_point)
+    ld = BinaryLoader(wf, minibatch_size=50,
+                      prng=RandomGenerator().seed(2))
+    ld.link_from(rep)
+    rbm = RBMTrainer(wf, n_hidden=32, learning_rate=0.2)
+    rbm.link_from(ld)
+    rbm.link_loader(ld)
+    wf.initialize(device=Device(backend="auto"))
+    errors = []
+    for _epoch in range(6):
+        for _ in range(12):
+            ld.run()
+            rbm.run()
+        errors.append(float(rbm.recon_error[0]))
+    assert errors[-1] < errors[0] * 0.7, errors
+
+
+def test_rnn_lstm_parity_and_training():
+    """jnp scan matches the numpy twin; an LSTM classifier trains on a
+    synthetic sequence task through the standard fused trainer."""
+    from veles_tpu.znicz.rnn import LSTM, SimpleRNN
+    from veles_tpu.loader.base import TEST, VALID, TRAIN
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    wf = Workflow(None)
+    for cls in (SimpleRNN, LSTM):
+        unit = cls(wf, hidden=8, prng=RandomGenerator().seed(4))
+        unit.input = numpy.random.RandomState(0).randn(
+            5, 7, 3).astype(numpy.float32)
+        unit.initialize(device=Device(backend="auto"))
+        out_jax = numpy.asarray(unit.apply(
+            {k: numpy.asarray(v) for k, v in unit.params.items()},
+            unit.input))
+        out_np = unit.apply_numpy(
+            {k: numpy.asarray(v) for k, v in unit.params.items()},
+            unit.input)
+        assert out_jax.shape == (5, 8)
+        assert numpy.abs(out_jax - out_np).max() < 1e-4, cls
+
+    class SeqLoader(FullBatchLoader):
+        MAPPING = "seq_test_loader"
+
+        def load_data(self):
+            rng = numpy.random.RandomState(7)
+            n, t = 600, 12
+            data = rng.randn(n, t, 4).astype(numpy.float32)
+            # class = sign of the mean of channel 0 (needs temporal
+            # aggregation to solve)
+            labels = (data[:, :, 0].mean(axis=1) > 0).astype(numpy.int32)
+            self.original_data.mem = data
+            self.original_labels = list(labels)
+            self.class_lengths[TEST] = 0
+            self.class_lengths[VALID] = 100
+            self.class_lengths[TRAIN] = 500
+
+    swf = StandardWorkflow(
+        None, name="SeqLSTM", loader_factory=SeqLoader,
+        loader={"minibatch_size": 50, "prng": RandomGenerator().seed(3)},
+        layers=[
+            {"type": "lstm", "->": {"hidden": 16},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": 2},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        ],
+        loss_function="softmax",
+        decision={"max_epochs": 8, "silent": True})
+    swf.initialize(device=Device(backend="auto"))
+    swf.run()
+    err = swf.gather_results()["best_validation_error_pt"]
+    assert err < 25.0, err  # chance is 50%

@@ -55,6 +55,13 @@ class Downloader(Unit):
         else:
             urllib.request.urlretrieve(self.url, target)
         self.unpack(target)
+        if self.files and not self.ready:
+            missing = [f for f in self.files if not os.path.exists(
+                os.path.join(self.directory, f))]
+            raise FileNotFoundError(
+                "downloaded %s but expected files are still missing: %s "
+                "(bad archive format or wrong contents?)"
+                % (self.url, ", ".join(missing)))
         return target
 
     def unpack(self, path):

@@ -302,6 +302,14 @@ class Loader(Unit, IResultProvider):
         self.normalizer.normalize(
             self.minibatch_data.map_write()[:self.minibatch_size])
 
+    def materialize_minibatch(self):
+        """Ensure minibatch_data/minibatch_labels hold the CURRENT
+        minibatch host-side.  Host-path loaders already do; loaders whose
+        gather is deferred into a consumer's jitted step (FullBatch under
+        link_fused_gather) override to fill on demand.  Host-side
+        consumers (MinibatchesSaver, ImageSaver, debuggers) call this
+        before reading the Arrays."""
+
     def map_minibatch_labels(self):
         if not self.has_labels:
             return

@@ -142,6 +142,14 @@ class FullBatchLoader(Loader):
     def normalize_minibatch(self):
         pass  # already baked into the resident dataset
 
+    def materialize_minibatch(self):
+        if not self.defer_device_gather and self._use_device:
+            # device gather ran; pull is lazy via Array.map_read
+            return
+        if self.defer_device_gather:
+            self.fill_minibatch()
+            self.map_minibatch_labels()
+
     def map_minibatch_labels(self):
         if not self.has_labels:
             return

@@ -11,7 +11,8 @@ import pickle
 import numpy
 
 from ..units import Unit
-from .base import Loader, TEST, VALID, TRAIN
+from .base import TEST, VALID, TRAIN
+from .fullbatch import FullBatchLoader
 
 
 class MinibatchesSaver(Unit):
@@ -29,6 +30,7 @@ class MinibatchesSaver(Unit):
         self._file_ = None
 
     def link_loader(self, loader):
+        self.loader = loader
         self.link_attrs(loader, "minibatch_data", "minibatch_labels",
                         "minibatch_size", "minibatch_class")
         return self
@@ -36,6 +38,8 @@ class MinibatchesSaver(Unit):
     def run(self):
         if self._file_ is None:
             self._file_ = open(self.path, "wb")
+        # deferred-gather loaders never fill the host Arrays on their own
+        self.loader.materialize_minibatch()
         size = int(self.minibatch_size)
         data = numpy.asarray(self.minibatch_data.map_read()[:size])
         labels = None
@@ -51,19 +55,18 @@ class MinibatchesSaver(Unit):
             self._file_ = None
 
 
-class MinibatchesLoader(Loader):
+class MinibatchesLoader(FullBatchLoader):
     """Replays a MinibatchesSaver file through the Loader protocol.
 
-    The records are concatenated per class into a resident dataset, so
-    shuffling/requeueing behave exactly like any other loader."""
+    The records are concatenated per class into the HBM-resident
+    FullBatch dataset, so shuffling/requeueing/device-gather behave
+    exactly like any other resident loader."""
 
     MAPPING = "minibatches_loader"
 
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)
         self.path = kwargs.get("path", "minibatches.pickle")
-        self._data = None
-        self._labels = None
 
     def load_data(self):
         per_class = {TEST: [], VALID: [], TRAIN: []}
@@ -86,26 +89,13 @@ class MinibatchesLoader(Loader):
                 labels.extend(per_class_labels[cls])
         if not chunks:
             raise ValueError("no minibatch records in %s" % self.path)
-        self._data = numpy.concatenate(chunks)
-        if labels and len(labels) != len(self._data):
+        data = numpy.concatenate(chunks).astype(numpy.float32)
+        if labels and len(labels) != len(data):
             # mixed labelled/unlabelled records would silently shift
             # every label onto the wrong sample
             raise ValueError(
                 "minibatch cache mixes labelled and unlabelled records "
-                "(%d labels for %d samples)" % (len(labels),
-                                                len(self._data)))
-        self._labels = labels
+                "(%d labels for %d samples)" % (len(labels), len(data)))
+        self.original_data.mem = data
+        self.original_labels = labels
         self.has_labels = bool(labels)
-
-    def create_minibatch_data(self):
-        self.minibatch_data.reset(numpy.zeros(
-            (self.max_minibatch_size,) + self._data.shape[1:],
-            numpy.float32))
-
-    def fill_minibatch(self):
-        idx = self.minibatch_indices.map_read()[:self.minibatch_size]
-        self.minibatch_data.map_write()[:self.minibatch_size] = \
-            self._data[idx]
-        if self.has_labels:
-            for i, sample_idx in enumerate(idx):
-                self.raw_minibatch_labels[i] = self._labels[sample_idx]

@@ -61,7 +61,11 @@ class StreamLoader(Loader):
         try:
             item = self.queue.get(timeout=self.timeout)
         except queue.Empty:
-            item = None
+            # transient producer delay, NOT a shutdown: serve an empty
+            # minibatch and stay alive (only close()'s None sentinel
+            # terminates the stream)
+            self.minibatch_size = 0
+            return
         if item is None:
             self.finished = True
             self.stopped = True

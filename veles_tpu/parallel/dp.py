@@ -66,7 +66,7 @@ class DistributedTrainStep(FusedTrainStep):
         self._train_step_ = jax.jit(
             self._train_step_.__wrapped__,
             in_shardings=(param_shard, opt_shard, scalar, batch_shard,
-                          label_shard, scalar, scalar),
+                          label_shard, scalar, scalar, scalar),
             out_shardings=(param_shard, opt_shard, scalar, scalar,
                            batch_shard),
             donate_argnums=(0, 1, 2))

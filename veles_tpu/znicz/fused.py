@@ -70,6 +70,9 @@ class FusedTrainStep(Unit, IResultProvider):
         # int32 so it passes as a jit scalar without overflow.
         self._seed_counter = (int(kwargs.get("seed", 42)) *
                               1_000_003) % 0x7FFF0000
+        # global learning-rate multiplier, set per epoch by
+        # LearningRateAdjuster; 1.0 = the configured base rates
+        self.lr_scale = 1.0
 
     def link_loader(self, loader):
         self.link_attrs(loader, "minibatch_data", "minibatch_labels",
@@ -194,7 +197,7 @@ class FusedTrainStep(Unit, IResultProvider):
             output otherwise.  The loss itself consumed the logits."""
             return jax.nn.softmax(out) if softmax_head else out
 
-        def train_step(params, opt, macc, x, y, size, seed):
+        def train_step(params, opt, macc, x, y, size, seed, lr_scale):
             mask = (jnp.arange(x.shape[0]) < size).astype(jnp.float32)
             (loss, out), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, x, y, mask, seed)
@@ -206,8 +209,12 @@ class FusedTrainStep(Unit, IResultProvider):
                     decay, l1l2, ortho = gd.decay_for(name)
                     g = solvers.regularized_grad(g, p, decay, l1l2, jnp,
                                                  ortho)
+                    # lr_scale: DYNAMIC schedule knob (LearningRateAdjuster)
+                    # — an argument, not a constant, so per-epoch decay
+                    # never retraces the step
                     delta, st = gd.solver.update(
-                        g, p, opt[i][name], gd.lr_for(name), jnp)
+                        g, p, opt[i][name], gd.lr_for(name) * lr_scale,
+                        jnp)
                     layer_p[name] = p + delta
                     layer_o[name] = st
                 new_params.append(layer_p)
@@ -248,10 +255,11 @@ class FusedTrainStep(Unit, IResultProvider):
                 self._y_dev_ = ld.original_targets.devmem
 
             def train_step_g(data, y_all, params, opt, macc, idx, size,
-                             seed):
+                             seed, lr_scale):
                 x = jnp.take(data, idx, axis=0)
                 y = jnp.take(y_all, idx, axis=0)
-                return train_step(params, opt, macc, x, y, size, seed)
+                return train_step(params, opt, macc, x, y, size, seed,
+                                  lr_scale)
 
             def eval_step_g(data, y_all, params, macc, idx, size):
                 x = jnp.take(data, idx, axis=0)
@@ -300,7 +308,7 @@ class FusedTrainStep(Unit, IResultProvider):
                     self._train_step_g_(
                         self._data_dev_, self._y_dev_, self._params_,
                         self._opt_, self._macc_, idx, size,
-                        self._seed_counter)
+                        self._seed_counter, float(self.lr_scale))
             else:
                 self._macc_, loss, out = self._eval_step_g_(
                     self._data_dev_, self._y_dev_, self._params_,
@@ -320,7 +328,8 @@ class FusedTrainStep(Unit, IResultProvider):
             self._seed_counter = (self._seed_counter + 1) % 0x7FFF0000
             (self._params_, self._opt_, self._macc_, loss, out) = \
                 self._train_step_(self._params_, self._opt_, self._macc_,
-                                  x, y, size, self._seed_counter)
+                                  x, y, size, self._seed_counter,
+                                  float(self.lr_scale))
         else:
             self._macc_, loss, out = self._eval_step_(
                 self._params_, self._macc_, x, y, size)

@@ -1,0 +1,83 @@
+"""ImageSaver: dump misclassified samples to disk per epoch.
+
+Re-creation of the Znicz image_saver unit (SURVEY §2.9): after each
+validation pass, write the wrongly-classified images into
+``directory/<epoch>/<true>_as_<predicted>_<i>.png`` for eyeballing what
+the model confuses.  Consumes the fused step's (or evaluator's) output
+probabilities plus the loader's minibatch.
+"""
+
+import os
+
+import numpy
+
+from ..units import Unit
+from .. import loader as loader_mod
+
+
+class ImageSaver(Unit):
+    MAPPING = "image_saver"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.directory = kwargs.get("directory", "image_saver")
+        self.limit = int(kwargs.get("limit", 32))      # per epoch
+        self.sample_shape = kwargs.get("sample_shape")  # e.g. (28, 28)
+        self.minibatch_data = None   # linked from loader
+        self.minibatch_labels = None
+        self.minibatch_size = None
+        self.minibatch_class = None
+        self.epoch_number = None
+        self.output = None           # linked from trainer/evaluator
+        self.saved = 0
+        self._epoch_saved = 0
+        self._seen_epoch = -1
+
+    def link_all(self, trainer, loader):
+        self.loader = loader
+        self.link_attrs(trainer, "output")
+        self.link_attrs(loader, "minibatch_data", "minibatch_labels",
+                        "minibatch_size", "minibatch_class",
+                        "epoch_number")
+        return self
+
+    def run(self):
+        if self.minibatch_class != loader_mod.VALID:
+            return
+        # deferred-gather loaders never fill the host Arrays on their own
+        self.loader.materialize_minibatch()
+        epoch = int(self.epoch_number)
+        if epoch != self._seen_epoch:
+            self._seen_epoch = epoch
+            self._epoch_saved = 0
+        if self._epoch_saved >= self.limit:
+            return
+        size = int(self.minibatch_size)
+        out = numpy.asarray(self.output.map_read()
+                            if hasattr(self.output, "map_read")
+                            else self.output)[:size]
+        labels = numpy.asarray(self.minibatch_labels.map_read()[:size])
+        data = numpy.asarray(self.minibatch_data.map_read()[:size])
+        pred = out.argmax(axis=-1)
+        wrong = numpy.nonzero(pred != labels)[0]
+        if not len(wrong):
+            return
+        epoch_dir = os.path.join(self.directory, "epoch_%d" % epoch)
+        os.makedirs(epoch_dir, exist_ok=True)
+        from PIL import Image
+        for i in wrong:
+            if self._epoch_saved >= self.limit:
+                break
+            img = data[i]
+            if self.sample_shape is not None:
+                img = img.reshape(self.sample_shape)
+            lo, hi = img.min(), img.max()
+            img8 = ((img - lo) / (hi - lo + 1e-12) * 255).astype("uint8")
+            if img8.ndim == 3 and img8.shape[-1] == 1:
+                img8 = img8[..., 0]
+            Image.fromarray(img8).save(os.path.join(
+                epoch_dir, "%s_as_%s_%d.png" %
+                (labels[i], pred[i], self._epoch_saved)))
+            self._epoch_saved += 1
+            self.saved += 1

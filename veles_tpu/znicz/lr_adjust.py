@@ -1,0 +1,88 @@
+"""Learning-rate adjusters (reference znicz lr_adjust family).
+
+Policies compute a multiplier over the configured base rates as a
+function of the epoch; the fused step consumes it as the DYNAMIC
+``lr_scale`` argument (no retrace per change), and graph-mode GD units
+apply their rates eagerly, so the adjuster mutates them directly.
+
+Policies (reference Caffe-style set):
+- ``exp``:    scale = gamma^epoch
+- ``step``:   scale = gamma^(epoch // step)
+- ``inv``:    scale = (1 + gamma*epoch)^(-power)
+- ``arbitrary``: explicit [(epoch, scale), ...] step points
+"""
+
+from ..units import Unit
+from .. import loader as loader_mod
+
+
+def make_policy(name, **kwargs):
+    gamma = float(kwargs.get("gamma", 0.9))
+    if name == "exp":
+        return lambda epoch: gamma ** epoch
+    if name == "step":
+        step = int(kwargs.get("step", 10))
+        return lambda epoch: gamma ** (epoch // step)
+    if name == "inv":
+        power = float(kwargs.get("power", 0.75))
+        return lambda epoch: (1.0 + gamma * epoch) ** -power
+    if name == "arbitrary":
+        points = sorted(kwargs["points"])  # [(epoch, scale), ...]
+
+        def arbitrary(epoch):
+            scale = 1.0
+            for at, value in points:
+                if epoch >= at:
+                    scale = value
+            return scale
+        return arbitrary
+    raise ValueError("unknown lr policy %r" % name)
+
+
+class LearningRateAdjuster(Unit):
+    """Applies a schedule once per epoch.
+
+    Wire: ``link_from(decision)``, ``link_loader(loader)``, and either
+    ``link_fused(fused_step)`` or ``link_gds(*gd_units)`` (graph mode).
+    """
+
+    MAPPING = "lr_adjuster"
+
+    def __init__(self, workflow, policy="exp", **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "TRAINER"
+        self.policy_name = policy
+        self.policy_kwargs = dict(kwargs)
+        self.policy_kwargs.pop("name", None)
+        self.epoch_ended = None      # linked
+        self.epoch_number = None
+        self.fused_step = None
+        self.gds = []
+        self._base_rates = None
+
+    def link_loader(self, loader):
+        self.link_attrs(loader, "epoch_ended", "epoch_number")
+        self.gate_skip = ~loader.epoch_ended
+        return self
+
+    def link_fused(self, fused_step):
+        self.fused_step = fused_step
+        return self
+
+    def link_gds(self, *gds):
+        self.gds = list(gds)
+        self._base_rates = [(gd.learning_rate, gd.learning_rate_bias)
+                            for gd in gds]
+        return self
+
+    def scale_for(self, epoch):
+        return make_policy(self.policy_name, **self.policy_kwargs)(epoch)
+
+    def run(self):
+        # schedule for the NEXT epoch (this runs at the end of one)
+        scale = self.scale_for(int(self.epoch_number) + 1)
+        if self.fused_step is not None:
+            self.fused_step.lr_scale = float(scale)
+        for gd, (base_w, base_b) in zip(self.gds, self._base_rates or ()):
+            gd.learning_rate = base_w * scale
+            gd.learning_rate_bias = base_b * scale

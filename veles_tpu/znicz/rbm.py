@@ -1,0 +1,118 @@
+"""Restricted Boltzmann Machine: CD-1 trainer (no-gradient path).
+
+Re-creation of the Znicz RBM units (reference model status: "units
+developed for NUMPY, workflow created but not tested" —
+/root/reference/docs/source/manualrst_veles_algorithms.rst:103-110).
+TPU-first: one jitted contrastive-divergence step per minibatch —
+sample h|v, reconstruct v'|h, resample h'|v', update
+W += lr/B * (v·h - v'·h') — with the Bernoulli draws keyed per step for
+determinism, and the reconstruction error accumulated on device.
+"""
+
+import numpy
+
+from ..memory import Array
+from ..result_provider import IResultProvider
+from ..units import Unit
+from .. import loader as loader_mod
+
+
+class RBMTrainer(Unit, IResultProvider):
+    """Binary-binary RBM trained with CD-1."""
+
+    MAPPING = "rbm_trainer"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "TRAINER"
+        self.n_hidden = int(kwargs.get("n_hidden", 64))
+        self.learning_rate = float(kwargs.get("learning_rate", 0.1))
+        self.weights_stddev = float(kwargs.get("weights_stddev", 0.01))
+        self.prng = kwargs.get("prng")
+        self.weights = Array()       # [n_visible, n_hidden]
+        self.vbias = Array()
+        self.hbias = Array()
+        self.minibatch_data = None   # linked
+        self.minibatch_size = None
+        self.minibatch_class = None
+        self.last_minibatch = None
+        self.epoch_number = None
+        self.recon_error = Array(numpy.zeros(1, numpy.float64))
+        self._seed_counter = int(kwargs.get("seed", 11)) % 0x7FFF0000
+        self._epoch_samples = 0
+
+    def link_loader(self, loader):
+        self.link_attrs(loader, "minibatch_data", "minibatch_size",
+                        "minibatch_class", "last_minibatch",
+                        "epoch_number")
+        return self
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(**kwargs)
+        self.device = device
+        import jax
+        import jax.numpy as jnp
+        from ..prng import RandomGenerator
+
+        n_visible = int(numpy.prod(self.minibatch_data.shape[1:]))
+        if not self.weights:
+            prng = self.prng or RandomGenerator().seed(2)
+            self.weights.mem = prng.normal(
+                0.0, self.weights_stddev,
+                (n_visible, self.n_hidden)).astype(numpy.float32)
+            self.vbias.mem = numpy.zeros(n_visible, numpy.float32)
+            self.hbias.mem = numpy.zeros(self.n_hidden, numpy.float32)
+
+        lr = self.learning_rate
+
+        def cd1(w, vb, hb, eacc, v, mask, seed):
+            key = jax.random.PRNGKey(seed)
+            kh, kv = jax.random.split(key)
+            B = v.shape[0]
+            ph = jax.nn.sigmoid(v @ w + hb)
+            h = (jax.random.uniform(kh, ph.shape) < ph).astype(v.dtype)
+            pv = jax.nn.sigmoid(h @ w.T + vb)
+            # mean-field reconstruction (standard CD-1: probabilities for
+            # the visible reconstruction, resampled hidden probs)
+            ph2 = jax.nn.sigmoid(pv @ w + hb)
+            m = mask[:, None]
+            nv = jnp.maximum(mask.sum(), 1.0)
+            dw = ((v * m).T @ ph - (pv * m).T @ ph2) / nv
+            dvb = ((v - pv) * m).sum(axis=0) / nv
+            dhb = ((ph - ph2) * m).sum(axis=0) / nv
+            err = (((v - pv) ** 2) * m).sum() / nv
+            return (w + lr * dw, vb + lr * dvb, hb + lr * dhb,
+                    eacc + err * mask.sum())
+
+        self._cd1_ = jax.jit(cd1, donate_argnums=(0, 1, 2, 3))
+        self._w_ = jnp.asarray(self.weights.map_read())
+        self._vb_ = jnp.asarray(self.vbias.map_read())
+        self._hb_ = jnp.asarray(self.hbias.map_read())
+        self._eacc_ = jnp.zeros((), jnp.float32)
+
+    def run(self):
+        if self.minibatch_class != loader_mod.TRAIN:
+            return
+        import jax.numpy as jnp
+        v = self.minibatch_data.devmem
+        v = v.reshape(v.shape[0], -1)
+        size = int(self.minibatch_size)
+        mask = (jnp.arange(v.shape[0]) < size).astype(v.dtype)
+        self._seed_counter = (self._seed_counter + 1) % 0x7FFF0000
+        (self._w_, self._vb_, self._hb_, self._eacc_) = self._cd1_(
+            self._w_, self._vb_, self._hb_, self._eacc_, v, mask,
+            self._seed_counter)
+        self._epoch_samples += size
+        if bool(self.last_minibatch):
+            import jax
+            self.recon_error.map_write()[0] = (
+                float(jax.device_get(self._eacc_)) /
+                max(self._epoch_samples, 1))
+            self._eacc_ = jnp.zeros((), jnp.float32)
+            self._epoch_samples = 0
+            self.weights.devmem = jnp.array(self._w_)
+            self.vbias.devmem = jnp.array(self._vb_)
+            self.hbias.devmem = jnp.array(self._hb_)
+
+    def get_metric_values(self):
+        return {"reconstruction_error": float(self.recon_error[0])}

@@ -1,0 +1,161 @@
+"""Recurrent forward units: SimpleRNN and LSTM.
+
+Re-creation of the Znicz RNN/LSTM units (reference model status: "built,
+not fully tested" — manualrst_veles_algorithms.rst:115-143).  TPU-first:
+the time recurrence is a ``lax.scan`` inside the pure ``apply`` (static
+sequence length, XLA-compiled loop), so the units compose with the fused
+trainer exactly like feed-forward layers — the generic vjp backward IS
+backprop-through-time, no hand-written BPTT kernels.
+
+Input: ``[batch, time, features]``; output: the last hidden state
+``[batch, hidden]`` (``return_sequences=True`` → ``[batch, time,
+hidden]``).
+"""
+
+import numpy
+
+from .nn_units import ForwardBase
+from .activations import get as get_activation
+
+
+class SimpleRNN(ForwardBase):
+    """h_t = tanh(x_t @ Wx + h_{t-1} @ Wh + b)."""
+
+    MAPPING = "rnn"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.hidden = int(kwargs.get("hidden", 64))
+        self.return_sequences = bool(kwargs.get("return_sequences", False))
+        self.activation = get_activation(
+            kwargs.get("activation", "tanh"))
+
+    def output_shape_for(self, input_shape):
+        b, t = input_shape[0], input_shape[1]
+        if self.return_sequences:
+            return (b, t, self.hidden)
+        return (b, self.hidden)
+
+    def init_params(self):
+        f = int(numpy.prod(self.input_shape[2:]))
+        self.fill_array(self.weights, (f + self.hidden, self.hidden),
+                        self.weights_stddev, self.weights_filling)
+        self.fill_array(self.bias, (self.hidden,), self.bias_stddev,
+                        self.bias_filling)
+
+    def apply(self, params, x):
+        import jax.numpy as jnp
+        from jax import lax
+        w, b = params["weights"], params["bias"]
+        f = x.shape[2] if x.ndim == 3 else int(
+            numpy.prod(x.shape[2:]))
+        x = x.reshape(x.shape[0], x.shape[1], f)
+        wx, wh = w[:f], w[f:]
+        h0 = jnp.zeros((x.shape[0], self.hidden), x.dtype)
+
+        def step(h, xt):
+            h = self.activation.fwd_jnp(xt @ wx + h @ wh + b)
+            return h, h
+        hT, hs = lax.scan(step, h0, jnp.swapaxes(x, 0, 1))
+        if self.return_sequences:
+            return jnp.swapaxes(hs, 0, 1)
+        return hT
+
+    def apply_numpy(self, params, x):
+        w, b = params["weights"], params["bias"]
+        f = x.shape[2]
+        wx, wh = w[:f], w[f:]
+        h = numpy.zeros((x.shape[0], self.hidden), x.dtype)
+        hs = []
+        for t in range(x.shape[1]):
+            h = self.activation.fwd_np(x[:, t] @ wx + h @ wh + b)
+            hs.append(h)
+        return numpy.stack(hs, axis=1) if self.return_sequences else h
+
+
+class LSTM(ForwardBase):
+    """Standard LSTM cell scanned over time (i, f, g, o gates packed in
+    one [f+h, 4h] weight matrix; forget-gate bias initialized to 1)."""
+
+    MAPPING = "lstm"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.hidden = int(kwargs.get("hidden", 64))
+        self.return_sequences = bool(kwargs.get("return_sequences", False))
+
+    def output_shape_for(self, input_shape):
+        b, t = input_shape[0], input_shape[1]
+        if self.return_sequences:
+            return (b, t, self.hidden)
+        return (b, self.hidden)
+
+    def init_params(self):
+        f = int(numpy.prod(self.input_shape[2:]))
+        H = self.hidden
+        self.fill_array(self.weights, (f + H, 4 * H),
+                        self.weights_stddev, self.weights_filling)
+        bias = numpy.zeros(4 * H, numpy.float32)
+        bias[H:2 * H] = 1.0  # forget-gate bias
+        self.bias.mem = bias
+
+    def _cell(self, xp, w, b, f_dim):
+        H = self.hidden
+        wx, wh = w[:f_dim], w[f_dim:]
+
+        def step(carry, xt, sigmoid, tanh):
+            h, c = carry
+            z = xt @ wx + h @ wh + b
+            i = sigmoid(z[:, :H])
+            fg = sigmoid(z[:, H:2 * H])
+            g = tanh(z[:, 2 * H:3 * H])
+            o = sigmoid(z[:, 3 * H:])
+            c = fg * c + i * g
+            h = o * tanh(c)
+            return (h, c), h
+        return step
+
+    def apply(self, params, x):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        w, b = params["weights"], params["bias"]
+        f = x.shape[2] if x.ndim == 3 else int(numpy.prod(x.shape[2:]))
+        x = x.reshape(x.shape[0], x.shape[1], f)
+        step = self._cell(jnp, w, b, f)
+        init = (jnp.zeros((x.shape[0], self.hidden), x.dtype),) * 2
+
+        def body(carry, xt):
+            return step(carry, xt, jax.nn.sigmoid, jnp.tanh)
+        (hT, _cT), hs = lax.scan(body, init, jnp.swapaxes(x, 0, 1))
+        if self.return_sequences:
+            return jnp.swapaxes(hs, 0, 1)
+        return hT
+
+    def apply_numpy(self, params, x):
+        w, b = params["weights"], params["bias"]
+        f = x.shape[2]
+        step = self._cell(numpy, w, b, f)
+
+        def sigmoid(v):
+            return 1.0 / (1.0 + numpy.exp(-v))
+        carry = (numpy.zeros((x.shape[0], self.hidden), x.dtype),) * 2
+        hs = []
+        for t in range(x.shape[1]):
+            carry, h = step(carry, x[:, t], sigmoid, numpy.tanh)
+            hs.append(h)
+        return numpy.stack(hs, axis=1) if self.return_sequences \
+            else carry[0]
+
+
+from .nn_units import GenericVJPBackward
+
+
+class GDRNN(GenericVJPBackward):
+    """BPTT for SimpleRNN via the generic vjp backward."""
+    MAPPING = "rnn"
+
+
+class GDLSTM(GenericVJPBackward):
+    """BPTT for LSTM via the generic vjp backward."""
+    MAPPING = "lstm"

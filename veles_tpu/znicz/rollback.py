@@ -1,0 +1,62 @@
+"""WeightsRollback: restore the best weights when training degrades.
+
+Re-creation of the Znicz rollback unit (SURVEY §2.9 "weight rollback
+unit"): keep a copy of the parameters from the best validation epoch;
+when validation fails to improve for ``improvement_limit`` consecutive
+epochs, restore that copy (optionally also damping the learning rate via
+the fused step's ``lr_scale``).
+"""
+
+from ..units import Unit
+
+
+class WeightsRollback(Unit):
+    MAPPING = "weights_rollback"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "TRAINER"
+        self.improvement_limit = int(kwargs.get("improvement_limit", 4))
+        self.lr_damping = float(kwargs.get("lr_damping", 1.0))
+        self.fused_step = None
+        self.decision = None
+        self.epoch_ended = None      # linked
+        self.rollbacks = 0
+        self._best_params_ = None
+        self._best_opt_ = None
+
+    def link_all(self, fused_step, decision, loader):
+        self.fused_step = fused_step
+        self.decision = decision
+        self.link_attrs(loader, "epoch_ended")
+        self.gate_skip = ~loader.epoch_ended
+        return self
+
+    def run(self):
+        import jax.numpy as jnp
+        step = self.fused_step
+        if bool(self.decision.improved):
+            # snapshot COPIES: the live buffers are donated next step
+            self._best_params_ = [
+                {k: jnp.array(v) for k, v in layer.items()}
+                for layer in step._params_]
+            self._best_opt_ = [
+                {k: tuple(jnp.array(s) for s in v)
+                 if isinstance(v, tuple) else jnp.array(v)
+                 for k, v in layer.items()}
+                for layer in step._opt_]
+            return
+        stale = getattr(self.decision, "epochs_without_improvement", 0)
+        if self._best_params_ is not None and \
+                stale and stale % self.improvement_limit == 0:
+            step._params_ = [
+                {k: jnp.array(v) for k, v in layer.items()}
+                for layer in self._best_params_]
+            step._opt_ = [
+                {k: tuple(jnp.array(s) for s in v)
+                 if isinstance(v, tuple) else jnp.array(v)
+                 for k, v in layer.items()}
+                for layer in self._best_opt_]
+            step.lr_scale = float(step.lr_scale) * self.lr_damping
+            step.sync_weights()
+            self.rollbacks += 1

@@ -66,13 +66,14 @@ class ScanEpochStep(FusedTrainStep):
             self._y_dev_ = self.loader.original_targets.devmem
 
         def train_scan(data_dev, y_dev, params, opt, macc, idx, sizes,
-                       seeds):
+                       seeds, lr_scale):
             def body(carry, batch):
                 p, o, m = carry
                 bidx, bsize, bseed = batch
                 x = jnp.take(data_dev, bidx, axis=0)
                 y = jnp.take(y_dev, bidx, axis=0)
-                p, o, m, loss, _ = train(p, o, m, x, y, bsize, bseed)
+                p, o, m, loss, _ = train(p, o, m, x, y, bsize, bseed,
+                                         lr_scale)
                 return (p, o, m), loss
             (params, opt, macc), losses = lax.scan(
                 body, (params, opt, macc), (idx, sizes, seeds))
@@ -139,7 +140,8 @@ class ScanEpochStep(FusedTrainStep):
             (self._params_, self._opt_, self._macc_, losses) = \
                 self._train_scan_(self._data_dev_, self._y_dev_,
                                   self._params_, self._opt_, self._macc_,
-                                  idx, sizes, self._next_seeds(len(sizes)))
+                                  idx, sizes, self._next_seeds(len(sizes)),
+                                  float(self.lr_scale))
         else:
             self._macc_, losses = self._eval_scan_(
                 self._data_dev_, self._y_dev_,
@@ -184,7 +186,8 @@ class ScanEpochStep(FusedTrainStep):
         (self._params_, self._opt_, self._macc_, losses) = \
             self._train_scan_(self._data_dev_, self._y_dev_,
                               self._params_, self._opt_, self._macc_,
-                              idx, sizes, self._next_seeds(len(sizes)))
+                              idx, sizes, self._next_seeds(len(sizes)),
+                              float(self.lr_scale))
         self.loss = losses[-1]
         ld.samples_served += int(sizes.sum())
         ld.minibatch_class = loader_mod.TRAIN
