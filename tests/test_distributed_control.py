@@ -1,0 +1,115 @@
+"""Distributed control plane: elastic checkpoint-restart with fault
+injection, DB snapshots, forge registry (VERDICT missing #8/#9)."""
+
+import json
+import os
+import urllib.request
+
+import numpy
+
+from veles_tpu.backends import Device
+from veles_tpu.distributed import ElasticRunner, latest_snapshot
+from veles_tpu.prng import RandomGenerator
+
+
+def test_elastic_checkpoint_restart(tmp_path):
+    """A run killed mid-training by deterministic fault injection is
+    resumed from its snapshot by the ElasticRunner and completes."""
+    snap_dir = str(tmp_path / "snaps")
+    result_file = str(tmp_path / "result.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    runner = ElasticRunner(
+        "veles_tpu/znicz/samples/mnist.py",
+        argv=["root.mnist.loader={'minibatch_size': 100, 'n_train': 300, "
+              "'n_valid': 100}",
+              "root.mnist.decision={'max_epochs': 5, 'silent': True}",
+              "root.mnist.snapshotter={'directory': %r, "
+              "'time_interval': 0}" % snap_dir,
+              "--random-seed", "3",
+              "--die-at-epoch", "2",
+              "--result-file", result_file],
+        snapshot_dir=snap_dir, max_respawns=3, backoff=0.1, env=env,
+        silent=True)
+    rc = runner.run()
+    assert rc == 0
+    # died at least once (epoch 2), then resumed from a snapshot
+    assert runner.respawns >= 1
+    assert runner.history[0]["rc"] == 66
+    assert runner.history[-1]["rc"] == 0
+    assert runner.history[-1]["resumed_from"]
+    results = json.load(open(result_file))
+    assert results["Total epochs"] == 4  # completed the full schedule
+
+
+def test_latest_snapshot_prefers_current_symlink(tmp_path):
+    d = str(tmp_path)
+    for name in ("wf.1.pickle.gz", "wf.2.pickle.gz"):
+        open(os.path.join(d, name), "wb").write(b"x")
+    assert latest_snapshot(d).endswith("wf.2.pickle.gz")
+    os.symlink("wf.1.pickle.gz", os.path.join(d, "wf_current"))
+    assert latest_snapshot(d).endswith("wf.1.pickle.gz")
+
+
+def test_snapshotter_to_db(tmp_path):
+    from veles_tpu.snapshotter import SnapshotterToDB, restore
+    from veles_tpu.znicz.samples import mnist
+    db = str(tmp_path / "snaps.sqlite3")
+    wf = mnist.create_workflow(
+        loader={"minibatch_size": 100, "n_train": 300, "n_valid": 100,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 2, "silent": True},
+        snapshotter=None)
+    snap = SnapshotterToDB(wf, database=db, time_interval=0,
+                           prefix="mnist")
+    snap.link_decision(wf.decision)
+    snap.link_from(wf.decision)
+    snap.skip = ~(wf.decision.improved & wf.loader.valid_ended)
+    wf.initialize(device=Device(backend="auto"))
+    wf.run()
+    uri = "sqlite://%s#mnist" % db
+    assert snap.destination == uri
+    wf2 = restore(uri)
+    assert wf2.name == "MnistSimple"
+    wf2.initialize(device=Device(backend="auto"))
+    # resumed workflow continues training
+    from veles_tpu.__main__ import Main  # noqa: F401 (import sanity)
+    wf2.decision.max_epochs = 3
+    wf2.run()
+    assert wf2.gather_results()["Total epochs"] >= 2
+
+
+def test_forge_round_trip(tmp_path):
+    from veles_tpu import forge
+    from veles_tpu.export import PackageLoader, export_model
+    from veles_tpu.znicz.samples import mnist
+    wf = mnist.create_workflow(
+        loader={"minibatch_size": 100, "n_train": 300, "n_valid": 100,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 1, "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    wf.run()
+    pkg = str(tmp_path / "model.zip")
+    export_model(wf, pkg)
+    server = forge.ForgeServer(str(tmp_path / "registry"), port=0)
+    try:
+        base = "http://127.0.0.1:%d" % server.port
+        manifest = forge.upload(base, "MnistSimple", "1.0", pkg,
+                                {"error_pt": 5.0})
+        assert manifest["name"] == "MnistSimple"
+        listed = forge.list_models(base)
+        assert len(listed) == 1 and listed[0]["error_pt"] == 5.0
+        fetched = str(tmp_path / "fetched.zip")
+        forge.fetch(base, "MnistSimple", fetched)
+        loader = PackageLoader(fetched)
+        assert loader.workflow_name == "MnistSimple"
+        x = numpy.asarray(wf.loader.original_data.map_read()[:2])
+        assert numpy.asarray(loader.run(x)).shape == (2, 10)
+        # missing model → 404 JSON
+        try:
+            urllib.request.urlopen(base + "/fetch?name=nope")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.stop()

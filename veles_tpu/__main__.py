@@ -131,6 +131,13 @@ def make_parser():
                    help="print per-unit timing stats after the run")
     p.add_argument("--no-fix-config", action="store_true",
                    help="keep Range placeholders (genetic optimizer use)")
+    p.add_argument("--death-probability", type=float, default=0.0,
+                   help="fault injection: crash with this probability at "
+                        "each epoch end (reference "
+                        "--slave-death-probability)")
+    p.add_argument("--die-at-epoch", type=int, default=None,
+                   help="fault injection: crash deterministically at this "
+                        "epoch end (elastic-recovery drills)")
     p.add_argument("--optimize", default=None, metavar="SIZE[:GENERATIONS]",
                    help="GA-optimize the config's Range values by running "
                         "trials as subprocesses (reference --optimize)")
@@ -192,6 +199,18 @@ class Main:
             for p in parts[:-1]:
                 obj = getattr(obj, p)
             setattr(obj, parts[-1], _parse_value(value))
+        if args.death_probability or args.die_at_epoch is not None:
+            from .distributed import Reaper
+            wf = self.workflow
+            reaper = next((u for u in wf if isinstance(u, Reaper)), None)
+            if reaper is None and hasattr(wf, "decision") and \
+                    hasattr(wf, "loader"):
+                reaper = Reaper(wf)
+                reaper.link_from(wf.decision)
+                reaper.link_loader(wf.loader)
+            if reaper is not None:
+                reaper.death_probability = args.death_probability
+                reaper.die_at_epoch = args.die_at_epoch
         self.launcher.add_workflow(self.workflow)
         return self.workflow, self.snapshot_loaded
 

@@ -1,0 +1,144 @@
+"""Distributed control plane: elastic checkpoint-restart + multi-host.
+
+The reference's elasticity was slave-granular: dropped slaves had their
+minibatches requeued and were respawned over SSH with backoff
+(/root/reference/veles/server.py:315-338,637-655; fault injection via
+--slave-death-probability, client.py:303-307).  On TPU, ICI collectives
+are gang-scheduled — a participant cannot leave mid-step — so recovery
+moves to CHECKPOINT-RESTART granularity (SURVEY.md §7 hard parts): the
+:class:`ElasticRunner` supervises a training process and, when it dies,
+relaunches it from the newest snapshot with exponential backoff.  The
+in-process loader keeps the reference's minibatch requeue contract for
+job-level accounting (loader/base.py); this module is the out-of-band
+driver above it.
+
+Fault injection for tests/drills mirrors the reference: the CLI's
+``--death-probability`` (random per-epoch crash) and the deterministic
+``--die-at-epoch`` hook.
+
+Multi-host: :func:`init_multihost` wraps ``jax.distributed.initialize``
+— processes coordinate over DCN, and every host's local chips join one
+global mesh; combined with parallel/mesh.py shardings the same jitted
+step then spans slices (collectives ride ICI within a slice, DCN
+across).
+"""
+
+import glob
+import os
+import subprocess
+import sys
+import time
+
+from .units import Unit
+
+
+class Reaper(Unit):
+    """Fault injection: crash the process at epoch boundaries.
+
+    (reference client.py:303-307 --slave-death-probability.)"""
+
+    MAPPING = "reaper"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.death_probability = float(kwargs.get("death_probability", 0.0))
+        self.die_at_epoch = kwargs.get("die_at_epoch")
+        self.epoch_number = None     # linked
+        self.prng = kwargs.get("prng")
+
+    def link_loader(self, loader):
+        self.link_attrs(loader, "epoch_number", "epoch_ended")
+        self.gate_skip = ~loader.epoch_ended
+        return self
+
+    def run(self):
+        epoch = int(self.epoch_number)
+        if self.die_at_epoch is not None and epoch == int(self.die_at_epoch):
+            os._exit(66)
+        if self.death_probability > 0:
+            import random
+            if random.random() < self.death_probability:
+                os._exit(66)
+
+
+def latest_snapshot(directory, prefix="wf"):
+    """Newest snapshot path in ``directory`` (prefers the ``_current``
+    symlink the snapshotter maintains)."""
+    link = os.path.join(directory, "%s_current" % prefix)
+    if os.path.islink(link) and os.path.exists(link):
+        return os.path.realpath(link)
+    candidates = glob.glob(os.path.join(directory, "%s*.pickle*" % prefix))
+    candidates = [c for c in candidates if not c.endswith("_current")]
+    if not candidates:
+        return None
+    return max(candidates, key=os.path.getmtime)
+
+
+class ElasticRunner:
+    """Supervise a CLI training run; restart from the newest snapshot on
+    crash (reference server.py:637-655 respawn-with-backoff, moved to
+    checkpoint granularity)."""
+
+    def __init__(self, model, argv=(), snapshot_dir=".", prefix="wf",
+                 max_respawns=5, backoff=1.0, backoff_factor=2.0,
+                 python=None, env=None, silent=False):
+        self.model = model
+        self.argv = list(argv)
+        self.snapshot_dir = snapshot_dir
+        self.prefix = prefix
+        self.max_respawns = max_respawns
+        self.backoff = backoff
+        self.backoff_factor = backoff_factor
+        self.python = python or sys.executable
+        self.env = env
+        self.silent = silent
+        self.respawns = 0
+        self.history = []
+
+    def run(self):
+        """Returns the final returncode (0 = the run completed)."""
+        delay = self.backoff
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        while True:
+            argv = [self.python, "-m", "veles_tpu", self.model] + self.argv
+            snapshot = latest_snapshot(self.snapshot_dir, self.prefix)
+            if snapshot:
+                argv += ["--snapshot", snapshot]
+            proc = subprocess.run(argv, cwd=repo, env=self.env,
+                                  capture_output=self.silent)
+            self.history.append({"rc": proc.returncode,
+                                 "resumed_from": snapshot})
+            if proc.returncode == 0:
+                return 0
+            if self.respawns >= self.max_respawns:
+                return proc.returncode
+            self.respawns += 1
+            if not self.silent:
+                print("elastic: run died rc=%d; respawn %d/%d in %.1fs"
+                      % (proc.returncode, self.respawns,
+                         self.max_respawns, delay), file=sys.stderr)
+            time.sleep(delay)
+            delay *= self.backoff_factor
+
+
+def init_multihost(coordinator_address=None, num_processes=None,
+                   process_id=None):
+    """Join this process to a multi-host JAX cluster (DCN control plane).
+
+    Thin wrapper over ``jax.distributed.initialize``: on TPU pods the
+    arguments come from the environment automatically; elsewhere pass the
+    coordinator's host:port and this process's rank.  After this, the
+    global device set spans all hosts and parallel/mesh.make_mesh can lay
+    a dp×tp mesh over it — the same fused step then trains multi-host
+    with no further code changes."""
+    import jax
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = int(num_processes)
+    if process_id is not None:
+        kwargs["process_id"] = int(process_id)
+    jax.distributed.initialize(**kwargs)
+    return jax.process_index(), jax.process_count()

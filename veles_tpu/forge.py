@@ -1,0 +1,244 @@
+"""Forge: a model package registry (server + client).
+
+Re-creation of /root/reference/veles/forge/ (forge_server.py Tornado
+upload/fetch/service endpoints with manifest.json per model;
+forge_client.py ``veles forge fetch/upload``).  Models here are the
+export packages (export.export_model zips) plus a manifest; the server
+is the stdlib HTTP stack the other services use (the email-confirmation
+workflow of the reference is internet-era scope this build drops).
+
+Endpoints (reference-compatible shapes):
+- ``GET /service?query=list``            → JSON list of manifests
+- ``GET /service?query=details&name=N``  → one manifest
+- ``GET /fetch?name=N[&version=V]``      → package bytes
+- ``POST /upload?name=N&version=V``      → store package (+ metadata)
+
+CLI: ``python -m veles_tpu.forge serve|upload|fetch|list ...``.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class ForgeStore:
+    """Directory-backed registry: <root>/<name>/<version>/package.zip +
+    manifest.json; 'latest' resolves to the newest upload."""
+
+    def __init__(self, directory):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _mdir(self, name, version):
+        safe = lambda s: "".join(c for c in s if c.isalnum() or
+                                 c in "._-")
+        return os.path.join(self.directory, safe(name), safe(version))
+
+    def upload(self, name, version, package_path, metadata=None):
+        d = self._mdir(name, version)
+        os.makedirs(d, exist_ok=True)
+        shutil.copy(package_path, os.path.join(d, "package.zip"))
+        manifest = {"name": name, "version": version,
+                    "uploaded": time.time(),
+                    "size": os.path.getsize(package_path),
+                    **(metadata or {})}
+        with open(os.path.join(d, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+        return manifest
+
+    def resolve(self, name, version=None):
+        base = os.path.join(self.directory, name)
+        if not os.path.isdir(base):
+            raise KeyError("no such model: %s" % name)
+        if version is None or version == "latest":
+            versions = sorted(
+                os.listdir(base),
+                key=lambda v: os.path.getmtime(os.path.join(base, v)))
+            if not versions:
+                raise KeyError("model %s has no versions" % name)
+            version = versions[-1]
+        d = os.path.join(base, version)
+        if not os.path.isdir(d):
+            raise KeyError("no such version: %s/%s" % (name, version))
+        return d
+
+    def manifest(self, name, version=None):
+        with open(os.path.join(self.resolve(name, version),
+                               "manifest.json")) as f:
+            return json.load(f)
+
+    def package_path(self, name, version=None):
+        return os.path.join(self.resolve(name, version), "package.zip")
+
+    def list(self):
+        out = []
+        for name in sorted(os.listdir(self.directory)):
+            base = os.path.join(self.directory, name)
+            if not os.path.isdir(base):
+                continue
+            for version in sorted(os.listdir(base)):
+                mf = os.path.join(base, version, "manifest.json")
+                if os.path.exists(mf):
+                    with open(mf) as f:
+                        out.append(json.load(f))
+        return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store = None
+
+    def log_message(self, *args):
+        pass
+
+    def _send_json(self, code, payload):
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _params(self):
+        return {k: v[0] for k, v in urllib.parse.parse_qs(
+            urllib.parse.urlparse(self.path).query).items()}
+
+    def do_GET(self):
+        route = urllib.parse.urlparse(self.path).path
+        q = self._params()
+        try:
+            if route == "/service":
+                if q.get("query") == "list":
+                    self._send_json(200, self.store.list())
+                elif q.get("query") == "details":
+                    self._send_json(200, self.store.manifest(
+                        q["name"], q.get("version")))
+                else:
+                    self._send_json(400, {"error": "unknown query"})
+            elif route == "/fetch":
+                path = self.store.package_path(q["name"],
+                                               q.get("version"))
+                with open(path, "rb") as f:
+                    data = f.read()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/zip")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            else:
+                self._send_json(404, {"error": "not found"})
+        except KeyError as e:
+            self._send_json(404, {"error": str(e)})
+
+    def do_POST(self):
+        route = urllib.parse.urlparse(self.path).path
+        q = self._params()
+        if route != "/upload" or "name" not in q or "version" not in q:
+            self._send_json(400, {"error": "upload needs name & version"})
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        data = self.rfile.read(length)
+        import tempfile
+        fd, tmp = tempfile.mkstemp(suffix=".zip")
+        try:
+            os.write(fd, data)
+            os.close(fd)
+            metadata = {}
+            if self.headers.get("X-Forge-Metadata"):
+                metadata = json.loads(self.headers["X-Forge-Metadata"])
+            manifest = self.store.upload(q["name"], q["version"], tmp,
+                                         metadata)
+            self._send_json(200, manifest)
+        finally:
+            os.unlink(tmp)
+
+
+class ForgeServer:
+    def __init__(self, directory, port=0):
+        self.store = ForgeStore(directory)
+        handler = type("Handler", (_Handler,), {"store": self.store})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="veles-tpu-forge")
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+# -- client ------------------------------------------------------------------
+def upload(base_url, name, version, package_path, metadata=None):
+    with open(package_path, "rb") as f:
+        data = f.read()
+    req = urllib.request.Request(
+        "%s/upload?%s" % (base_url, urllib.parse.urlencode(
+            {"name": name, "version": version})), data,
+        {"Content-Type": "application/zip",
+         "X-Forge-Metadata": json.dumps(metadata or {})})
+    return json.loads(urllib.request.urlopen(req).read())
+
+
+def fetch(base_url, name, dest, version=None):
+    q = {"name": name}
+    if version:
+        q["version"] = version
+    data = urllib.request.urlopen(
+        "%s/fetch?%s" % (base_url, urllib.parse.urlencode(q))).read()
+    with open(dest, "wb") as f:
+        f.write(data)
+    return dest
+
+
+def list_models(base_url):
+    return json.loads(urllib.request.urlopen(
+        "%s/service?query=list" % base_url).read())
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(prog="veles_tpu.forge")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("serve")
+    s.add_argument("directory")
+    s.add_argument("--port", type=int, default=8180)
+    u = sub.add_parser("upload")
+    u.add_argument("url")
+    u.add_argument("name")
+    u.add_argument("version")
+    u.add_argument("package")
+    f = sub.add_parser("fetch")
+    f.add_argument("url")
+    f.add_argument("name")
+    f.add_argument("dest")
+    f.add_argument("--version", default=None)
+    ls = sub.add_parser("list")
+    ls.add_argument("url")
+    args = p.parse_args(argv)
+    if args.cmd == "serve":
+        server = ForgeServer(args.directory, args.port)
+        print("forge serving %s on port %d" % (args.directory,
+                                               server.port))
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            server.stop()
+    elif args.cmd == "upload":
+        print(json.dumps(upload(args.url, args.name, args.version,
+                                args.package)))
+    elif args.cmd == "fetch":
+        print(fetch(args.url, args.name, args.dest, args.version))
+    else:
+        print(json.dumps(list_models(args.url), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,8 +26,14 @@ class StreamLoader(Loader):
         self.queue = kwargs.get("queue") or queue.Queue(
             maxsize=int(kwargs.get("maxsize", 64)))
         self.timeout = kwargs.get("timeout")  # None = block forever
+        # give up after this many CONSECUTIVE timeouts (None = wait for
+        # the producer forever — a dead producer then needs close());
+        # guards workflows against producers that die without the
+        # sentinel
+        self.max_timeouts = kwargs.get("max_timeouts")
         self.sample_shape = tuple(kwargs.get("sample_shape", ()))
         self.finished = False
+        self._consecutive_timeouts = 0
 
     def feed(self, data, labels=None):
         """Producer side: enqueue one batch."""
@@ -62,10 +68,17 @@ class StreamLoader(Loader):
             item = self.queue.get(timeout=self.timeout)
         except queue.Empty:
             # transient producer delay, NOT a shutdown: serve an empty
-            # minibatch and stay alive (only close()'s None sentinel
-            # terminates the stream)
-            self.minibatch_size = 0
-            return
+            # minibatch and stay alive (close()'s None sentinel — or
+            # max_timeouts consecutive dry polls — terminates)
+            self._consecutive_timeouts += 1
+            if self.max_timeouts is not None and \
+                    self._consecutive_timeouts >= self.max_timeouts:
+                item = None
+            else:
+                self.minibatch_size = 0
+                return
+        else:
+            self._consecutive_timeouts = 0
         if item is None:
             self.finished = True
             self.stopped = True

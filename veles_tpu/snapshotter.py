@@ -207,7 +207,87 @@ class SnapshotterToFile(SnapshotterBase):
         return wf
 
 
+class SnapshotterToDB(SnapshotterBase):
+    """Snapshots into a SQLite database (reference SnapshotterToDB,
+    snapshotter.py:428-520, used ODBC; SQLite is the zero-dependency
+    equivalent — same pickle blobs, queryable history, single file)."""
+
+    MAPPING = "db"
+
+    SCHEMA = ("CREATE TABLE IF NOT EXISTS snapshots ("
+              "id INTEGER PRIMARY KEY AUTOINCREMENT, "
+              "prefix TEXT, suffix TEXT, counter INTEGER, "
+              "timestamp REAL, blob BLOB)")
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.database = kwargs.get("database") or os.path.join(
+            os.path.expanduser(root.common.dirs.get("snapshots", ".")),
+            "snapshots.sqlite3")
+
+    def export(self):
+        import sqlite3
+        os.makedirs(os.path.dirname(os.path.abspath(self.database)),
+                    exist_ok=True)
+        target = self.workflow
+        fused = getattr(target, "fused_step", None)
+        if fused is not None:
+            fused.sync_weights()
+            fused.sync_solver_state()
+        blob = pickle.dumps(target, protocol=pickle.HIGHEST_PROTOCOL)
+        with sqlite3.connect(self.database) as conn:
+            conn.execute(self.SCHEMA)
+            conn.execute(
+                "INSERT INTO snapshots (prefix, suffix, counter, "
+                "timestamp, blob) VALUES (?, ?, ?, ?, ?)",
+                (self.prefix, self.suffix, self._counter, time.time(),
+                 sqlite3.Binary(blob)))
+        self.destination = "sqlite://%s#%s" % (self.database, self.prefix)
+        return self.destination
+
+    @staticmethod
+    def import_db(uri):
+        """``sqlite://<path>[#prefix]`` → newest matching snapshot."""
+        import sqlite3
+        body = uri[len("sqlite://"):]
+        path, _, prefix = body.partition("#")
+        if not os.path.exists(path):
+            # connect() would CREATE an empty junk db at the typo'd path
+            raise ValueError("no such snapshot database: %s" % path)
+        with sqlite3.connect(path) as conn:
+            if prefix:
+                row = conn.execute(
+                    "SELECT blob FROM snapshots WHERE prefix = ? "
+                    "ORDER BY id DESC LIMIT 1", (prefix,)).fetchone()
+            else:
+                row = conn.execute(
+                    "SELECT blob FROM snapshots "
+                    "ORDER BY id DESC LIMIT 1").fetchone()
+        if row is None:
+            raise ValueError("no snapshot in %s" % uri)
+        wf = pickle.loads(row[0])
+        wf._restored_from_snapshot = True
+        return wf
+
+
 def restore(path):
     """Convenience resume entry: returns the restored (uninitialized)
-    workflow; call .initialize(device=...) then .run()."""
+    workflow; call .initialize(device=...) then .run().
+
+    Sources (reference __main__.py:539-589 file/odbc/http): a snapshot
+    file path, ``sqlite://db.sqlite3[#prefix]``, or an ``http(s)://``
+    URL (fetched to a temp file first)."""
+    if path.startswith("sqlite://"):
+        return SnapshotterToDB.import_db(path)
+    if path.startswith(("http://", "https://")):
+        import tempfile
+        import urllib.request
+        suffix = os.path.splitext(path)[1] or ".pickle"
+        fd, tmp = tempfile.mkstemp(suffix=suffix)
+        os.close(fd)
+        try:
+            urllib.request.urlretrieve(path, tmp)
+            return SnapshotterToFile.import_file(tmp)
+        finally:
+            os.unlink(tmp)
     return SnapshotterToFile.import_file(path)

@@ -37,3 +37,5 @@ from . import activation                                 # noqa: F401
 from .misc_units import (Cutter, GDCutter, ChannelSplitter,
                          ChannelMerger, ZeroFiller, Deconv, GDDeconv,
                          Depooling)                      # noqa: F401
+from . import (image_saver, kohonen, lr_adjust, rbm,   # noqa: F401,E402
+               rnn, rollback)

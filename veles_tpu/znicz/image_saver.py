@@ -45,14 +45,14 @@ class ImageSaver(Unit):
     def run(self):
         if self.minibatch_class != loader_mod.VALID:
             return
-        # deferred-gather loaders never fill the host Arrays on their own
-        self.loader.materialize_minibatch()
         epoch = int(self.epoch_number)
         if epoch != self._seen_epoch:
             self._seen_epoch = epoch
             self._epoch_saved = 0
         if self._epoch_saved >= self.limit:
-            return
+            return  # before materialize: no host gather once full
+        # deferred-gather loaders never fill the host Arrays on their own
+        self.loader.materialize_minibatch()
         size = int(self.minibatch_size)
         out = numpy.asarray(self.output.map_read()
                             if hasattr(self.output, "map_read")

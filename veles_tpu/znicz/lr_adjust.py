@@ -54,11 +54,22 @@ class LearningRateAdjuster(Unit):
         self.policy_name = policy
         self.policy_kwargs = dict(kwargs)
         self.policy_kwargs.pop("name", None)
+        # built once: a bad policy name/points fails at construction,
+        # not a full epoch later
+        self._policy_ = make_policy(policy, **self.policy_kwargs)
         self.epoch_ended = None      # linked
         self.epoch_number = None
         self.fused_step = None
         self.gds = []
         self._base_rates = None
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        # also invoked mid-__init__ (before our attributes exist): only
+        # rebuild the callable on a real unpickle
+        name = self.__dict__.get("policy_name")
+        if name is not None:
+            self._policy_ = make_policy(name, **self.policy_kwargs)
 
     def link_loader(self, loader):
         self.link_attrs(loader, "epoch_ended", "epoch_number")
@@ -76,13 +87,16 @@ class LearningRateAdjuster(Unit):
         return self
 
     def scale_for(self, epoch):
-        return make_policy(self.policy_name, **self.policy_kwargs)(epoch)
+        return self._policy_(epoch)
 
     def run(self):
         # schedule for the NEXT epoch (this runs at the end of one)
         scale = self.scale_for(int(self.epoch_number) + 1)
         if self.fused_step is not None:
-            self.fused_step.lr_scale = float(scale)
+            # compose with any accumulated damping (WeightsRollback) —
+            # an absolute assignment would silently undo it
+            damping = getattr(self.fused_step, "lr_damping", 1.0)
+            self.fused_step.lr_scale = float(scale * damping)
         for gd, (base_w, base_b) in zip(self.gds, self._base_rates or ()):
             gd.learning_rate = base_w * scale
             gd.learning_rate_bias = base_b * scale

@@ -57,6 +57,10 @@ class WeightsRollback(Unit):
                  if isinstance(v, tuple) else jnp.array(v)
                  for k, v in layer.items()}
                 for layer in self._best_opt_]
+            # record the damping separately so a LearningRateAdjuster's
+            # per-epoch assignment composes with it instead of erasing it
+            step.lr_damping = getattr(step, "lr_damping", 1.0) * \
+                self.lr_damping
             step.lr_scale = float(step.lr_scale) * self.lr_damping
             step.sync_weights()
             self.rollbacks += 1
