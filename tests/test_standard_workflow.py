@@ -252,3 +252,23 @@ def test_mnist_sample_converges():
     wf.run()
     assert wf.is_finished
     assert wf.decision.best_n_err_pt < 5.0, wf.decision.best_n_err_pt
+
+
+def test_bf16_mixed_precision_trains():
+    """compute_dtype=bfloat16: forward/backward in bf16, master weights
+    f32 — converges on the synthetic MNIST twin like f32 does."""
+    import numpy
+    from veles_tpu.znicz.samples import mnist
+    wf = mnist.create_workflow(
+        loader={"minibatch_size": 100, "n_train": 1000, "n_valid": 300,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 3, "silent": True},
+        trainer={"compute_dtype": "bfloat16"})
+    wf.initialize(device=Device(backend="auto"))
+    wf.run()
+    err = wf.gather_results()["best_validation_error_pt"]
+    assert err < 10.0, err
+    # master params stayed f32
+    import jax
+    leaves = jax.tree_util.tree_leaves(wf.fused_step._params_)
+    assert all(leaf.dtype == numpy.float32 for leaf in leaves)

@@ -205,7 +205,12 @@ class Main:
             reaper = next((u for u in wf if isinstance(u, Reaper)), None)
             if reaper is None and hasattr(wf, "decision") and \
                     hasattr(wf, "loader"):
-                reaper = Reaper(wf)
+                from .prng import RandomGenerator
+                # own seeded stream: drills replay under --random-seed
+                # without consuming the loaders' stream
+                seed = int(args.random_seed if args.random_seed
+                           is not None else 1234) + 313
+                reaper = Reaper(wf, prng=RandomGenerator().seed(seed))
                 reaper.link_from(wf.decision)
                 reaper.link_loader(wf.loader)
             if reaper is not None:

@@ -57,8 +57,12 @@ class Reaper(Unit):
         if self.die_at_epoch is not None and epoch == int(self.die_at_epoch):
             os._exit(66)
         if self.death_probability > 0:
-            import random
-            if random.random() < self.death_probability:
+            if self.prng is not None:
+                draw = float(self.prng.uniform(0, 1))  # reproducible
+            else:
+                import random
+                draw = random.random()
+            if draw < self.death_probability:
                 os._exit(66)
 
 

@@ -34,10 +34,15 @@ class ForgeStore:
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
 
+    @staticmethod
+    def _safe(s):
+        """Sanitize path components (uploads AND lookups must agree, and
+        traversal like ../../ must never leave the registry root)."""
+        return "".join(c for c in s if c.isalnum() or c in "._-")             .lstrip(".")
+
     def _mdir(self, name, version):
-        safe = lambda s: "".join(c for c in s if c.isalnum() or
-                                 c in "._-")
-        return os.path.join(self.directory, safe(name), safe(version))
+        return os.path.join(self.directory, self._safe(name),
+                            self._safe(version))
 
     def upload(self, name, version, package_path, metadata=None):
         d = self._mdir(name, version)
@@ -52,7 +57,7 @@ class ForgeStore:
         return manifest
 
     def resolve(self, name, version=None):
-        base = os.path.join(self.directory, name)
+        base = os.path.join(self.directory, self._safe(name))
         if not os.path.isdir(base):
             raise KeyError("no such model: %s" % name)
         if version is None or version == "latest":
@@ -62,7 +67,7 @@ class ForgeStore:
             if not versions:
                 raise KeyError("model %s has no versions" % name)
             version = versions[-1]
-        d = os.path.join(base, version)
+        d = os.path.join(base, self._safe(version))
         if not os.path.isdir(d):
             raise KeyError("no such version: %s/%s" % (name, version))
         return d
@@ -115,11 +120,17 @@ class _Handler(BaseHTTPRequestHandler):
                 if q.get("query") == "list":
                     self._send_json(200, self.store.list())
                 elif q.get("query") == "details":
+                    if "name" not in q:
+                        self._send_json(400, {"error": "name required"})
+                        return
                     self._send_json(200, self.store.manifest(
                         q["name"], q.get("version")))
                 else:
                     self._send_json(400, {"error": "unknown query"})
             elif route == "/fetch":
+                if "name" not in q:
+                    self._send_json(400, {"error": "name required"})
+                    return
                 path = self.store.package_path(q["name"],
                                                q.get("version"))
                 with open(path, "rb") as f:
@@ -140,28 +151,32 @@ class _Handler(BaseHTTPRequestHandler):
         if route != "/upload" or "name" not in q or "version" not in q:
             self._send_json(400, {"error": "upload needs name & version"})
             return
-        length = int(self.headers.get("Content-Length", 0))
-        data = self.rfile.read(length)
-        import tempfile
-        fd, tmp = tempfile.mkstemp(suffix=".zip")
         try:
-            os.write(fd, data)
-            os.close(fd)
-            metadata = {}
-            if self.headers.get("X-Forge-Metadata"):
-                metadata = json.loads(self.headers["X-Forge-Metadata"])
-            manifest = self.store.upload(q["name"], q["version"], tmp,
-                                         metadata)
-            self._send_json(200, manifest)
-        finally:
-            os.unlink(tmp)
+            length = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(length)
+            import tempfile
+            fd, tmp = tempfile.mkstemp(suffix=".zip")
+            try:
+                os.write(fd, data)
+                os.close(fd)
+                metadata = {}
+                if self.headers.get("X-Forge-Metadata"):
+                    metadata = json.loads(
+                        self.headers["X-Forge-Metadata"])
+                manifest = self.store.upload(q["name"], q["version"],
+                                             tmp, metadata)
+                self._send_json(200, manifest)
+            finally:
+                os.unlink(tmp)
+        except Exception as e:  # the client must get a JSON answer
+            self._send_json(400, {"error": str(e)})
 
 
 class ForgeServer:
-    def __init__(self, directory, port=0):
+    def __init__(self, directory, port=0, host="127.0.0.1"):
         self.store = ForgeStore(directory)
         handler = type("Handler", (_Handler,), {"store": self.store})
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
@@ -208,6 +223,8 @@ def main(argv=None):
     s = sub.add_parser("serve")
     s.add_argument("directory")
     s.add_argument("--port", type=int, default=8180)
+    s.add_argument("--host", default="127.0.0.1",
+                   help="bind address (0.0.0.0 to serve off-box)")
     u = sub.add_parser("upload")
     u.add_argument("url")
     u.add_argument("name")
@@ -222,7 +239,7 @@ def main(argv=None):
     ls.add_argument("url")
     args = p.parse_args(argv)
     if args.cmd == "serve":
-        server = ForgeServer(args.directory, args.port)
+        server = ForgeServer(args.directory, args.port, args.host)
         print("forge serving %s on port %d" % (args.directory,
                                                server.port))
         try:

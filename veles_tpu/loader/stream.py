@@ -29,8 +29,11 @@ class StreamLoader(Loader):
         # give up after this many CONSECUTIVE timeouts (None = wait for
         # the producer forever — a dead producer then needs close());
         # guards workflows against producers that die without the
-        # sentinel
+        # sentinel.  Meaningless without a finite poll timeout, so one
+        # is derived when absent.
         self.max_timeouts = kwargs.get("max_timeouts")
+        if self.max_timeouts is not None and self.timeout is None:
+            self.timeout = 5.0
         self.sample_shape = tuple(kwargs.get("sample_shape", ()))
         self.finished = False
         self._consecutive_timeouts = 0

@@ -18,6 +18,7 @@ evaluator exposes, so Decision units work unchanged.
 
 import numpy
 
+from ..config import root
 from ..memory import Array
 from ..result_provider import IResultProvider
 from ..units import Unit
@@ -73,6 +74,14 @@ class FusedTrainStep(Unit, IResultProvider):
         # global learning-rate multiplier, set per epoch by
         # LearningRateAdjuster; 1.0 = the configured base rates
         self.lr_scale = 1.0
+        # mixed precision: "bfloat16" runs the forward/backward matmuls
+        # in bf16 (full MXU rate) while master params, the loss, and the
+        # solver update stay f32 — the standard TPU recipe.  None = f32
+        # throughout (bit-parity with graph mode).
+        self.compute_dtype = kwargs.get(
+            "compute_dtype", root.common.engine.get("dtype", "float32"))
+        if self.compute_dtype in ("float32", None):
+            self.compute_dtype = None
 
     def link_loader(self, loader):
         self.link_attrs(loader, "minibatch_data", "minibatch_labels",
@@ -116,7 +125,16 @@ class FusedTrainStep(Unit, IResultProvider):
         softmax_head = isinstance(forwards[-1], All2AllSoftmax)
         has_stochastic = any(f.stochastic for f in forwards)
 
+        cdtype = self.compute_dtype
+        if cdtype is not None:
+            cdtype = jnp.dtype(cdtype)
+
         def net_apply(params, x, with_logits, seed):
+            if cdtype is not None:
+                # cast once at the boundary; XLA keeps everything in
+                # compute dtype through the chain (MXU native rate)
+                params = jax.tree.map(lambda p: p.astype(cdtype), params)
+                x = x.astype(cdtype)
             h = x
             train = seed is not None
             if train and has_stochastic:
@@ -134,6 +152,9 @@ class FusedTrainStep(Unit, IResultProvider):
 
         def loss_fn(params, x, labels_or_targets, mask, seed=None):
             out = net_apply(params, x, True, seed)
+            # the loss itself is f32: bf16 log-sum-exp/reduction noise
+            # would feed straight into the gradients' scale
+            out = out.astype(jnp.float32)
             if loss_kind == "softmax":
                 data_loss = EvaluatorSoftmax.loss_from_logits(
                     out, labels_or_targets, mask)
