@@ -293,3 +293,22 @@ def test_gate_block_does_not_latch_inputs():
     wf.run()
     assert join.counter == 0
     assert not any(join.links_from.values())  # nothing latched while blocked
+
+
+def test_contract_verification():
+    """Half-implemented units fail fast at initialize (reference
+    verified.py zope contract role)."""
+    import pytest
+    from veles_tpu.loader.base import Loader
+    from veles_tpu.workflow import Workflow
+
+    class Half(Loader):
+        MAPPING = "half_loader"
+
+        def load_data(self):
+            pass
+        # create_minibatch_data / fill_minibatch missing
+
+    wf = Workflow(None)
+    with pytest.raises(TypeError, match="create_minibatch_data"):
+        Half(wf, minibatch_size=4).initialize()

@@ -131,6 +131,8 @@ def make_parser():
                    help="print per-unit timing stats after the run")
     p.add_argument("--no-fix-config", action="store_true",
                    help="keep Range placeholders (genetic optimizer use)")
+    from .cmdline import contribute_arguments
+    p._veles_arg_paths = contribute_arguments(p)
     p.add_argument("--death-probability", type=float, default=0.0,
                    help="fault injection: crash with this probability at "
                         "each epoch end (reference "
@@ -157,7 +159,9 @@ class Main:
     (reference __main__.py:136,591-726)."""
 
     def __init__(self, argv=None):
-        self.args = make_parser().parse_args(argv)
+        parser = make_parser()
+        self.args = parser.parse_args(argv)
+        self._arg_paths = parser._veles_arg_paths
         self.launcher = None
         self.workflow = None
         self.snapshot_loaded = False
@@ -264,6 +268,10 @@ class Main:
             if not value:
                 raise SystemExit("override %r needs =value" % override)
             set_config_by_path(root, path, _parse_value(value))
+        # class-contributed options (reference cmdline.py distributed
+        # argparse) — applied LAST so an explicit flag beats config files
+        from .cmdline import apply_arguments
+        apply_arguments(args, self._arg_paths, set_config_by_path, root)
         if args.optimize or args.ensemble_train:
             return self._run_meta(module)
         if not args.no_fix_config:
@@ -313,6 +321,12 @@ class Main:
             argv += ["--set", assignment]
         if args.random_seed is not None:
             argv += ["--random-seed", str(args.random_seed)]
+        # class-contributed flags travel as config overrides so trials
+        # see them too (the flags themselves are parsed per process)
+        for dest, path in self._arg_paths.items():
+            value = getattr(args, dest, None)
+            if value is not None:
+                argv.append("%s=%r" % (path, value))
         return argv
 
     def _write_result(self, payload):

@@ -1,0 +1,61 @@
+"""Distributed argparse: classes contribute their own CLI options.
+
+Re-creation of /root/reference/veles/cmdline.py
+(CommandLineArgumentsRegistry): every registered class could add
+argparse options via its metaclass (e.g. backends.py:351-370,
+loader/base.py:561-566).  Here classes declare a ``CLI_ARGUMENTS``
+mapping (flag → argparse kwargs + a ``config`` dotted path); the main
+parser collects them all, and parsed values are written into the config
+tree before the workflow builds — so ``--train-ratio 0.5`` works for
+every loader without each sample wiring it."""
+
+_contributors = []
+
+
+def register_arguments(owner, arguments):
+    """``arguments``: iterable of (flag, argparse_kwargs, config_path).
+    ``config_path`` is where the parsed value lands in ``root``."""
+    _contributors.append((owner, list(arguments)))
+
+
+def contribute_arguments(parser):
+    """Add every registered class's options to ``parser``; returns
+    {dest: config_path} for :func:`apply_arguments`."""
+    dest_to_path = {}
+    for owner, arguments in _contributors:
+        group = parser.add_argument_group("%s options" % owner)
+        for flag, kwargs, config_path in arguments:
+            action = group.add_argument(flag, **kwargs)
+            dest_to_path[action.dest] = config_path
+    return dest_to_path
+
+
+def apply_arguments(args, dest_to_path, set_config_by_path, root):
+    """Write parsed values into the config tree (None = not given)."""
+    for dest, path in dest_to_path.items():
+        value = getattr(args, dest, None)
+        if value is not None:
+            set_config_by_path(root, path, value)
+
+
+# -- built-in contributions (the reference's own examples) -------------------
+register_arguments("Loader", [
+    ("--train-ratio",
+     {"type": float, "default": None,
+      "help": "use this fraction of the train set (ensembles/ablation; "
+              "reference loader/base.py:561-566)"},
+     "root.common.ensemble.train_ratio"),
+])
+register_arguments("Device", [
+    ("--precision-level",
+     {"type": int, "default": None, "choices": (0, 1, 2),
+      "help": "matmul precision 0/1/2 = default/high/highest "
+              "(reference GEMM PRECISION_LEVEL)"},
+     "root.common.engine.precision_level"),
+])
+register_arguments("FusedTrainStep", [
+    ("--compute-dtype",
+     {"default": None, "choices": ("float32", "bfloat16"),
+      "help": "mixed-precision compute dtype for the fused step"},
+     "root.common.engine.dtype"),
+])

@@ -38,7 +38,13 @@ class ForgeStore:
     def _safe(s):
         """Sanitize path components (uploads AND lookups must agree, and
         traversal like ../../ must never leave the registry root)."""
-        return "".join(c for c in s if c.isalnum() or c in "._-")             .lstrip(".")
+        out = "".join(c for c in s if c.isalnum() or c in "._-")
+        out = out.lstrip(".")
+        if not out:
+            # '..', '.', '///' etc. must not silently collapse into a
+            # shorter join that escapes or aliases registry levels
+            raise KeyError("invalid name/version: %r" % s)
+        return out
 
     def _mdir(self, name, version):
         return os.path.join(self.directory, self._safe(name),

@@ -129,6 +129,10 @@ class Loader(Unit, IResultProvider):
         return TRAIN
 
     # -- ILoader interface ---------------------------------------------------
+    #: methods every concrete loader must implement (reference ILoader,
+    #: verified at initialize by veles_tpu.verified.verify_contract)
+    CONTRACT = ("load_data", "create_minibatch_data", "fill_minibatch")
+
     def load_data(self):
         raise NotImplementedError
 
@@ -147,6 +151,8 @@ class Loader(Unit, IResultProvider):
 
     # -- lifecycle -----------------------------------------------------------
     def initialize(self, **kwargs):
+        from ..verified import verify_contract
+        verify_contract(self, Loader)
         super().initialize(**kwargs)
         self.load_data()
         if sum(self.class_lengths) == 0:

@@ -60,6 +60,9 @@ class StreamLoader(Loader):
             (self.max_minibatch_size,) + self.sample_shape,
             numpy.float32))
 
+    def fill_minibatch(self):
+        pass  # batches arrive pre-filled through feed()
+
     def analyze_dataset(self):
         pass  # no resident data to analyze
 

@@ -137,7 +137,13 @@ class ForwardBase(NNUnitBase):
         before the first run (reference forwards allocate in initialize)."""
         raise NotImplementedError
 
+    #: methods every concrete forward must implement (verified at
+    #: initialize — reference verified.py contract role)
+    CONTRACT = ("apply", "output_shape_for")
+
     def initialize(self, device=None, **kwargs):
+        from ..verified import verify_contract
+        verify_contract(self, ForwardBase)
         super().initialize(device=device, **kwargs)
         if not self.weights:
             self.init_params()
