@@ -212,3 +212,43 @@ def test_alexnet_builds_and_steps():
     wf.fused_step.run()
     loss = float(wf.fused_step.loss)
     assert loss == loss and loss > 0
+
+
+def test_pallas_lrn_matches_reference_and_grads():
+    """The Pallas LRN kernel pair (fwd + analytic custom-vjp bwd) matches
+    the plain jnp formula and the numpy twin, values AND gradients."""
+    import jax
+    import jax.numpy as jnp
+    import numpy
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.znicz.lrn import LRNormalizerForward
+
+    wf = Workflow(None)
+    unit_p = LRNormalizerForward(wf, n=5, alpha=1e-4, beta=0.75, k=2.0,
+                                 use_pallas=True)
+    unit_j = LRNormalizerForward(wf, n=5, alpha=1e-4, beta=0.75, k=2.0,
+                                 use_pallas=False)
+    x = numpy.random.RandomState(3).randn(4, 5, 5, 16).astype(
+        numpy.float32)
+    y_p = numpy.asarray(unit_p.apply({}, jnp.asarray(x)))
+    y_j = numpy.asarray(unit_j.apply({}, jnp.asarray(x)))
+    y_np = unit_p.apply_numpy({}, x)
+    assert numpy.abs(y_p - y_j).max() < 1e-5
+    assert numpy.abs(y_p - y_np).max() < 1e-5
+
+    def loss_p(v):
+        return (unit_p.apply({}, v) ** 2).sum()
+
+    def loss_j(v):
+        return (unit_j.apply({}, v) ** 2).sum()
+    g_p = numpy.asarray(jax.grad(loss_p)(jnp.asarray(x)))
+    g_j = numpy.asarray(jax.grad(loss_j)(jnp.asarray(x)))
+    assert numpy.abs(g_p - g_j).max() < 1e-4, numpy.abs(g_p - g_j).max()
+    # even-n (asymmetric) windows must also agree across paths
+    for n in (2, 4):
+        up = LRNormalizerForward(wf, n=n, use_pallas=True)
+        uj = LRNormalizerForward(wf, n=n, use_pallas=False)
+        yp = numpy.asarray(up.apply({}, jnp.asarray(x)))
+        yj = numpy.asarray(uj.apply({}, jnp.asarray(x)))
+        assert numpy.abs(yp - yj).max() < 1e-5, (n, numpy.abs(yp - yj).max())
+        assert numpy.abs(yp - up.apply_numpy({}, x)).max() < 1e-5

@@ -29,13 +29,18 @@ def train(model, size, train_ratio=1.0, argv=(), out_file=None,
     """Train ``size`` instances, return the aggregated results dict."""
     python = python or sys.executable
     from ..subproc import run_trial
+    # an explicit train-ratio override already in the trial argv (e.g.
+    # from the --train-ratio flag) wins over our default
+    ratio_override = ["root.common.ensemble.train_ratio=%r" % train_ratio]
+    if any(str(a).startswith("root.common.ensemble.train_ratio=")
+           for a in argv):
+        ratio_override = []
     instances = []
     for i in range(size):
         rc, results, error = run_trial(
             model,
-            list(argv) +
-            ["root.common.ensemble.train_ratio=%r" % train_ratio,
-             "--random-seed", str(base_seed + i)],
+            list(argv) + ratio_override +
+            ["--random-seed", str(base_seed + i)],
             timeout=timeout, env=env, python=python)
         entry = {"instance": i, "seed": base_seed + i, "rc": rc}
         if results is not None:
