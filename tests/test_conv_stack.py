@@ -246,9 +246,16 @@ def test_pallas_lrn_matches_reference_and_grads():
     assert numpy.abs(g_p - g_j).max() < 1e-4, numpy.abs(g_p - g_j).max()
     # even-n (asymmetric) windows must also agree across paths
     for n in (2, 4):
-        up = LRNormalizerForward(wf, n=n, use_pallas=True)
-        uj = LRNormalizerForward(wf, n=n, use_pallas=False)
+        up = LRNormalizerForward(wf, n=n, alpha=0.5, use_pallas=True)
+        uj = LRNormalizerForward(wf, n=n, alpha=0.5, use_pallas=False)
         yp = numpy.asarray(up.apply({}, jnp.asarray(x)))
         yj = numpy.asarray(uj.apply({}, jnp.asarray(x)))
         assert numpy.abs(yp - yj).max() < 1e-5, (n, numpy.abs(yp - yj).max())
         assert numpy.abs(yp - up.apply_numpy({}, x)).max() < 1e-5
+        # asymmetric windows need the TRANSPOSED window in the VJP
+        gp = numpy.asarray(jax.grad(
+            lambda v: (up.apply({}, v) ** 2).sum())(jnp.asarray(x)))
+        gj = numpy.asarray(jax.grad(
+            lambda v: (uj.apply({}, v) ** 2).sum())(jnp.asarray(x)))
+        assert numpy.abs(gp - gj).max() < 1e-4, \
+            (n, numpy.abs(gp - gj).max())

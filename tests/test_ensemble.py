@@ -29,7 +29,7 @@ def test_ensemble_train_and_vote(tmp_path):
               "root.mnist.decision={'max_epochs': 2, 'silent': True}",
               "root.mnist.snapshotter={'directory': %r, "
               "'time_interval': 0}" % snap_dir],
-        out_file=out_file, env=env, silent=True, timeout=300)
+        out_file=out_file, env=env, silent=True, timeout=540)
     assert all(e["rc"] == 0 for e in out["instances"]), out
     summary = out["summary"]
     assert summary["best_validation_error_pt"]["n"] == 3

@@ -90,7 +90,7 @@ def test_optimizer_subprocess_cli():
             model="veles_tpu/znicz/samples/mnist.py", config=root.mnist,
             size=2, generations=1,
             argv=[cfg_file, "--random-seed", "3"], silent=True, env=env,
-            rand=RandomGenerator().seed(4), timeout=300)
+            rand=RandomGenerator().seed(4), timeout=540)
         best = opt.run()
         assert best["fitness"] > -100.0, best  # trials ran and returned
         assert opt.trials >= 2

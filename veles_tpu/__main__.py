@@ -348,10 +348,18 @@ class Main:
         if args.ensemble_train:
             from . import ensemble
             size, _, ratio = args.ensemble_train.partition(":")
+            trial_argv = self._trial_argv()
+            if ratio:
+                # an explicit N:ratio is the most specific setting —
+                # strip any --train-ratio-derived override so it wins
+                trial_argv = [
+                    a for a in trial_argv if not str(a).startswith(
+                        "root.common.ensemble.train_ratio=")]
             out = ensemble.train(
                 args.workflow, int(size),
-                train_ratio=float(ratio) if ratio else 1.0,
-                argv=self._trial_argv(),
+                train_ratio=float(ratio) if ratio
+                else (args.train_ratio or 1.0),
+                argv=trial_argv,
                 out_file=(args.result_file
                           if args.result_file not in (None, "-") else None))
             if args.result_file in (None, "-"):

@@ -30,15 +30,20 @@ import numpy
 from .nn_units import ParamlessForward, GenericVJPBackward
 
 
-def _window_sum(v, n, xp):
+def _window_sum(v, n, xp, transpose=False):
     """Channel-axis sliding-window sum via static shifted concats (the
     form that lowers cleanly inside Pallas — jnp.roll/pad do not).
     Offsets are ``-n//2 .. n-1-n//2`` — the exact (asymmetric for even
-    n) window the jnp/numpy ``_den`` formula uses."""
+    n) window the jnp/numpy ``_den`` formula uses.  ``transpose=True``
+    negates the offsets: the VJP of an asymmetric window sum is the
+    window sum over the TRANSPOSED window (for odd n they coincide)."""
     C = v.shape[-1]
     half = n // 2
+    offsets = range(-half, n - half)
+    if transpose:
+        offsets = [-o for o in offsets]
     acc = None
-    for off in range(-half, n - half):
+    for off in offsets:
         if off == 0:
             t = v
         elif off > 0:
@@ -86,7 +91,8 @@ def _pallas_lrn_bwd(n, alpha, beta, k, x, g):
         den = k + c * _window_sum(xv * xv, n, jnp)
         inner = gv * xv * den ** (-beta - 1.0)
         o_ref[...] = (gv * den ** -beta -
-                      2.0 * beta * c * xv * _window_sum(inner, n, jnp))
+                      2.0 * beta * c * xv *
+                      _window_sum(inner, n, jnp, transpose=True))
 
     dx = pl.pallas_call(
         kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
@@ -112,13 +118,7 @@ class LRNormalizerForward(ParamlessForward):
             "use_pallas", root.common.engine.get("use_pallas", False)))
 
     def _den(self, sq, xp):
-        half = self.n // 2
-        pad = [(0, 0)] * sq.ndim
-        pad[-1] = (half, half)
-        padded = xp.pad(sq, pad)
-        acc = xp.zeros_like(sq)
-        for d in range(self.n):
-            acc = acc + padded[..., d:d + sq.shape[-1]]
+        acc = _window_sum(sq, self.n, xp)
         return (self.k + (self.alpha / self.n) * acc) ** self.beta
 
     def apply(self, params, x):
