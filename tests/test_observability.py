@@ -108,3 +108,32 @@ def test_web_status_end_to_end():
         assert "MnistSimple" in html
     finally:
         server.stop()
+
+
+def test_plots_browser(tmp_path):
+    """/plots lists and serves plot artifacts (the reference web/
+    dashboard role, minimal)."""
+    from veles_tpu.plotting_units import AccumulatingPlotter
+    root.common.dirs.plots = str(tmp_path)
+    server = StatusServer(0, StatusRegistry())
+    try:
+        wf = _make_wf()
+        acc = AccumulatingPlotter(wf, name="errcurve",
+                                  directory=str(tmp_path), render=True)
+        acc.link_attrs(wf.decision, ("input", "epoch_n_err_pt"))
+        acc.input_field = 1
+        acc.link_from(wf.decision)
+        acc.link_loader(wf.loader)
+        wf.run()
+        base = "http://127.0.0.1:%d" % server.port
+        index = urllib.request.urlopen(base + "/plots").read().decode()
+        assert "errcurve.jsonl" in index and "errcurve.png" in index
+        series = urllib.request.urlopen(
+            base + "/plots/errcurve.jsonl").read().decode()
+        assert len(series.strip().splitlines()) == 2
+        png = urllib.request.urlopen(
+            base + "/plots/errcurve.png").read()
+        assert png[:4] == b"\x89PNG"
+    finally:
+        server.stop()
+        del root.common.dirs.plots

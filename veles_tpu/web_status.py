@@ -17,11 +17,15 @@ epoch; dead entries age out after ``gc_timeout`` like the reference's
 garbage collection.
 """
 
+import html as html_mod
 import json
+import os
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .config import root
 from .units import Unit
 
 
@@ -58,7 +62,7 @@ class _Handler(BaseHTTPRequestHandler):
         pass  # silent; the event log is the observability channel
 
     def _send(self, code, body, ctype="application/json"):
-        data = body.encode()
+        data = body if isinstance(body, bytes) else body.encode()
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
@@ -66,9 +70,12 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def do_GET(self):
-        if self.path.startswith("/status"):
+        route = urllib.parse.urlparse(self.path).path
+        if route == "/status":
             self._send(200, json.dumps(self.registry.snapshot(), indent=2))
-        elif self.path == "/":
+        elif route == "/plots" or route.startswith("/plots/"):
+            self._serve_plots(route)
+        elif route == "/":
             rows = []
             for key, e in sorted(self.registry.snapshot().items()):
                 rows.append(
@@ -81,10 +88,40 @@ class _Handler(BaseHTTPRequestHandler):
                 "<title>veles_tpu status</title></head><body>"
                 "<h2>Workflows</h2><table border=1>"
                 "<tr><th>workflow</th><th>epoch</th><th>metrics</th>"
-                "<th>age</th></tr>%s</table></body></html>"
+                "<th>age</th></tr>%s</table>"
+                "<p><a href=\"/plots\">plots</a> · "
+                "<a href=\"/status\">status JSON</a></p></body></html>"
                 % "".join(rows)), "text/html")
         else:
             self._send(404, '{"error": "not found"}')
+
+    def _serve_plots(self, route):
+        """Minimal plots browser (the reference web/ dashboard role):
+        /plots lists the plot artifacts in the plots directory; /plots/
+        <name> serves the JSONL series or PNG render."""
+        directory = root.common.dirs.get("plots", ".")
+        rel = urllib.parse.unquote(route[len("/plots"):].lstrip("/"))
+        if not rel:
+            entries = []
+            if os.path.isdir(directory):
+                entries = sorted(os.listdir(directory))
+            rows = "".join(
+                '<li><a href="/plots/%s">%s</a></li>' %
+                (urllib.parse.quote(name), html_mod.escape(name))
+                for name in entries)
+            self._send(200, ("<html><body><h2>Plots (%s)</h2><ul>%s</ul>"
+                             "</body></html>") %
+                       (html_mod.escape(directory), rows), "text/html")
+            return
+        safe = os.path.basename(rel)  # no traversal
+        path = os.path.join(directory, safe)
+        if not os.path.isfile(path):
+            self._send(404, '{"error": "no such plot"}')
+            return
+        with open(path, "rb") as f:
+            data = f.read()
+        ctype = "image/png" if safe.endswith(".png") else "text/plain"
+        self._send(200, data, ctype)
 
     def do_POST(self):
         if self.path != "/update":
