@@ -38,3 +38,22 @@ def test_kohonen_som_organizes():
     # results surface through the IResultProvider protocol
     res = wf.gather_results()
     assert res["final_quantization_error"] == h[-1]
+
+
+def test_stl10_sample_trains():
+    """STL-10 convnet (BASELINE.md accuracy table row 3) builds and
+    learns on the synthetic twin."""
+    from veles_tpu import prng
+    from veles_tpu.znicz.samples import stl10
+    # weight init draws from the GLOBAL generator — reseed it so the
+    # gate is order-independent across the suite
+    prng.get().seed(42)
+    wf = stl10.create_workflow(
+        loader={"minibatch_size": 50, "n_train": 300, "n_valid": 100,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 6, "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    wf.run()
+    res = wf.gather_results()
+    # synthetic classes are separable: well under the 90% chance floor
+    assert res["best_validation_error_pt"] < 50.0, res

@@ -99,7 +99,13 @@ class _Handler(BaseHTTPRequestHandler):
         """Minimal plots browser (the reference web/ dashboard role):
         /plots lists the plot artifacts in the plots directory; /plots/
         <name> serves the JSONL series or PNG render."""
-        directory = root.common.dirs.get("plots", ".")
+        directory = root.common.dirs.get("plots", None)
+        if not directory:
+            # never fall back to CWD: that would serve arbitrary files
+            # from the server process's working directory
+            self._send(404, '{"error": "plots directory not configured '
+                            '(set root.common.dirs.plots)"}')
+            return
         rel = urllib.parse.unquote(route[len("/plots"):].lstrip("/"))
         if not rel:
             entries = []

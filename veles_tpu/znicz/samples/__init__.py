@@ -1,2 +1,22 @@
 """Sample model workflows (the Znicz samples inventory — SURVEY.md §2.9:
-MNIST, MnistSimple, MnistAE, CIFAR10, AlexNet, Kohonen, Lines...)."""
+MNIST, MnistSimple, MnistAE, CIFAR10, AlexNet, STL10, Kohonen...)."""
+
+
+def build_standard(cfg, name, default_loader_factory, loss_function,
+                   **overrides):
+    """Shared config-merge for the StandardWorkflow samples: defaults
+    from the sample's config namespace, overridden per call."""
+    from ..standard_workflow import StandardWorkflow
+    decision = cfg.decision.todict()
+    decision.update(overrides.pop("decision", {}))
+    loader = cfg.loader.todict()
+    loader.update(overrides.pop("loader", {}))
+    layers = overrides.pop("layers", cfg.layers)
+    if "snapshotter" in cfg and "snapshotter" not in overrides:
+        overrides["snapshotter"] = cfg.snapshotter.todict()
+    return StandardWorkflow(
+        None, name=name,
+        loader_factory=overrides.pop("loader_factory",
+                                     default_loader_factory),
+        loader=loader, layers=layers, loss_function=loss_function,
+        decision=decision, **overrides)

@@ -95,23 +95,9 @@ class SyntheticImagenetLoader(FullBatchLoader):
 
 
 def create_workflow(fused=True, **overrides):
-    cfg = root.alexnet
-    decision = cfg.decision.todict()
-    decision.update(overrides.pop("decision", {}))
-    loader = cfg.loader.todict()
-    loader.update(overrides.pop("loader", {}))
-    layers = overrides.pop("layers", cfg.layers)
-    if "snapshotter" in cfg and "snapshotter" not in overrides:
-        overrides["snapshotter"] = cfg.snapshotter.todict()
-    loader_factory = overrides.pop("loader_factory",
-                                   SyntheticImagenetLoader)
-    return StandardWorkflow(
-        None, name="AlexNet",
-        loader_factory=loader_factory,
-        loader=loader, layers=layers,
-        loss_function="softmax", decision=decision, fused=fused,
-        **overrides)
-
+    from . import build_standard
+    return build_standard(root.alexnet, "AlexNet", SyntheticImagenetLoader, "softmax",
+                          fused=fused, **overrides)
 
 def run(load, main):
     load(create_workflow)

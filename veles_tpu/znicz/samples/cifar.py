@@ -117,21 +117,9 @@ class CifarLoader(FullBatchLoader):
 
 
 def create_workflow(fused=True, **overrides):
-    cfg = root.cifar
-    decision = cfg.decision.todict()
-    decision.update(overrides.pop("decision", {}))
-    loader = cfg.loader.todict()
-    loader.update(overrides.pop("loader", {}))
-    layers = overrides.pop("layers", cfg.layers)
-    if "snapshotter" in cfg and "snapshotter" not in overrides:
-        overrides["snapshotter"] = cfg.snapshotter.todict()
-    return StandardWorkflow(
-        None, name="CifarConvnet",
-        loader_factory=overrides.pop("loader_factory", CifarLoader),
-        loader=loader, layers=layers,
-        loss_function="softmax", decision=decision, fused=fused,
-        **overrides)
-
+    from . import build_standard
+    return build_standard(root.cifar, "CifarConvnet", CifarLoader, "softmax",
+                          fused=fused, **overrides)
 
 def run(load, main):
     load(create_workflow)

@@ -53,26 +53,9 @@ class MnistLoader(FullBatchLoader):
 
 
 def create_workflow(fused=True, **overrides):
-    cfg = root.mnist
-    decision = cfg.decision.todict()
-    decision.update(overrides.pop("decision", {}))
-    loader = cfg.loader.todict()
-    loader.update(overrides.pop("loader", {}))
-    layers = overrides.pop("layers", cfg.layers)
-    if "snapshotter" in cfg and "snapshotter" not in overrides:
-        overrides["snapshotter"] = cfg.snapshotter.todict()
-    return StandardWorkflow(
-        None,
-        name="MnistSimple",
-        loader_factory=overrides.pop("loader_factory", MnistLoader),
-        loader=loader,
-        layers=layers,
-        loss_function="softmax",
-        decision=decision,
-        fused=fused,
-        **overrides,  # epoch_scan, mesh, model_axis, ...
-    )
-
+    from . import build_standard
+    return build_standard(root.mnist, "MnistSimple", MnistLoader, "softmax",
+                          fused=fused, **overrides)
 
 def run(load, main):
     """CLI convention (reference manualrst_veles_workflow_creation.rst:

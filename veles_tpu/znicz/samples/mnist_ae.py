@@ -60,26 +60,9 @@ class MnistAELoader(FullBatchLoaderMSE):
 
 
 def create_workflow(fused=True, **overrides):
-    cfg = root.mnist_ae
-    decision = cfg.decision.todict()
-    decision.update(overrides.pop("decision", {}))
-    loader = cfg.loader.todict()
-    loader.update(overrides.pop("loader", {}))
-    layers = overrides.pop("layers", cfg.layers)
-    if "snapshotter" in cfg and "snapshotter" not in overrides:
-        overrides["snapshotter"] = cfg.snapshotter.todict()
-    return StandardWorkflow(
-        None,
-        name="MnistAE",
-        loader_factory=overrides.pop("loader_factory", MnistAELoader),
-        loader=loader,
-        layers=layers,
-        loss_function="mse",
-        decision=decision,
-        fused=fused,
-        **overrides,
-    )
-
+    from . import build_standard
+    return build_standard(root.mnist_ae, "MnistAE", MnistAELoader, "mse",
+                          fused=fused, **overrides)
 
 def run(load, main):
     load(create_workflow)
