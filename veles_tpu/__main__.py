@@ -151,6 +151,14 @@ def make_parser():
     p.add_argument("--ensemble-test", default=None, metavar="FILE.json",
                    help="averaged-probability inference over the "
                         "ensemble train output JSON")
+    p.add_argument("--listen", default=None, metavar="HOST:PORT",
+                   help="farm --optimize/--ensemble-train trials through "
+                        "a TCP job master bound here; start workers on "
+                        "any host with `python -m veles_tpu.jobserver "
+                        "HOST PORT` (reference master -l role)")
+    p.add_argument("--workers", type=int, default=0, metavar="N",
+                   help="also spawn N local trial worker processes "
+                        "(elastic: dead workers respawn with backoff)")
     return p
 
 
@@ -274,6 +282,11 @@ class Main:
         apply_arguments(args, self._arg_paths, set_config_by_path, root)
         if args.optimize or args.ensemble_train:
             return self._run_meta(module)
+        if args.listen or args.workers:
+            raise SystemExit(
+                "--listen/--workers distribute --optimize/--ensemble-train "
+                "trials; pass one of those meta flags (a plain training "
+                "run is a single process — use --mesh for multi-chip)")
         if not args.no_fix_config:
             fix_config(root)
         if args.dump_config:
@@ -304,7 +317,9 @@ class Main:
         args = self.args
         argv = []
         if args.config:
-            argv.append(args.config)
+            # trials run with cwd=repo root (subproc.run_trial); a
+            # relative config path from the user's cwd must survive that
+            argv.append(os.path.abspath(args.config))
         argv += args.overrides
         if args.backend:
             argv += ["--backend", args.backend]
@@ -343,41 +358,60 @@ class Main:
         handled earlier in run(): it needs no workflow module).  The
         reference ran these same meta-workflows by re-invoking its own
         CLI per trial (optimization_workflow.py:286-296,
-        ensemble/base_workflow.py:134-141)."""
+        ensemble/base_workflow.py:134-141).  With --listen/--workers the
+        trials go through the cross-host job queue (jobserver.py)."""
         args = self.args
-        if args.ensemble_train:
-            from . import ensemble
-            size, _, ratio = args.ensemble_train.partition(":")
+        scheduler = pool = None
+        if args.listen or args.workers:
+            from .jobserver import JobMaster, WorkerPool, parse_address
+            host, port = parse_address(args.listen) if args.listen \
+                else ("127.0.0.1", 0)
+            scheduler = JobMaster(host, port, silent=False)
+            if args.workers:
+                pool = WorkerPool(scheduler.address, args.workers)
+        try:
+            if args.ensemble_train:
+                from . import ensemble
+                size, _, ratio = args.ensemble_train.partition(":")
+                trial_argv = self._trial_argv()
+                if ratio:
+                    # an explicit N:ratio is the most specific setting —
+                    # strip any --train-ratio-derived override so it wins
+                    trial_argv = [
+                        a for a in trial_argv if not str(a).startswith(
+                            "root.common.ensemble.train_ratio=")]
+                out = ensemble.train(
+                    args.workflow, int(size),
+                    train_ratio=float(ratio) if ratio
+                    else (args.train_ratio or 1.0),
+                    argv=trial_argv, scheduler=scheduler,
+                    out_file=(args.result_file
+                              if args.result_file not in (None, "-")
+                              else None))
+                if args.result_file in (None, "-"):
+                    self._write_result(out["summary"])
+                return 0
+            from .genetics import GeneticsOptimizer
+            size, _, gens = args.optimize.partition(":")
             trial_argv = self._trial_argv()
-            if ratio:
-                # an explicit N:ratio is the most specific setting —
-                # strip any --train-ratio-derived override so it wins
-                trial_argv = [
-                    a for a in trial_argv if not str(a).startswith(
-                        "root.common.ensemble.train_ratio=")]
-            out = ensemble.train(
-                args.workflow, int(size),
-                train_ratio=float(ratio) if ratio
-                else (args.train_ratio or 1.0),
-                argv=trial_argv,
-                out_file=(args.result_file
-                          if args.result_file not in (None, "-") else None))
-            if args.result_file in (None, "-"):
-                self._write_result(out["summary"])
+            if args.random_seed is None:
+                # trials must still be deterministic relative to each other
+                trial_argv += ["--random-seed", "1234"]
+            opt = GeneticsOptimizer(
+                model=args.workflow, config=root, size=int(size),
+                generations=int(gens) if gens else 2,
+                fitness_key=args.fitness_key, argv=trial_argv,
+                scheduler=scheduler)
+            best = opt.run()
+            self._write_result(best)
             return 0
-        from .genetics import GeneticsOptimizer
-        size, _, gens = args.optimize.partition(":")
-        trial_argv = self._trial_argv()
-        if args.random_seed is None:
-            # trials must still be deterministic relative to each other
-            trial_argv += ["--random-seed", "1234"]
-        opt = GeneticsOptimizer(
-            model=args.workflow, config=root, size=int(size),
-            generations=int(gens) if gens else 2,
-            fitness_key=args.fitness_key, argv=trial_argv)
-        best = opt.run()
-        self._write_result(best)
-        return 0
+        finally:
+            # master first: its EOF is what makes idle workers exit 0,
+            # so the pool close below reaps them instead of killing them
+            if scheduler is not None:
+                scheduler.close()
+            if pool is not None:
+                pool.close()
 
 
 def main(argv=None):

@@ -25,32 +25,51 @@ import numpy
 
 def train(model, size, train_ratio=1.0, argv=(), out_file=None,
           base_seed=1000, python=None, timeout=None, silent=False,
-          env=None):
-    """Train ``size`` instances, return the aggregated results dict."""
+          env=None, scheduler=None):
+    """Train ``size`` instances, return the aggregated results dict.
+
+    With ``scheduler`` (a :class:`veles_tpu.jobserver.JobMaster`), the
+    instances run concurrently on whatever workers are connected — the
+    reference farmed ensemble instances to its slaves the same way
+    (ensemble/base_workflow.py:134-141)."""
     python = python or sys.executable
-    from ..subproc import run_trial
     # an explicit train-ratio override already in the trial argv (e.g.
     # from the --train-ratio flag) wins over our default
     ratio_override = ["root.common.ensemble.train_ratio=%r" % train_ratio]
     if any(str(a).startswith("root.common.ensemble.train_ratio=")
            for a in argv):
         ratio_override = []
+    trial_argvs = [list(argv) + ratio_override +
+                   ["--random-seed", str(base_seed + i)]
+                   for i in range(size)]
+    if scheduler is not None:
+        outcomes = scheduler.map(
+            [{"kind": "trial", "model": model, "argv": ta,
+              "timeout": timeout, "env": dict(env) if env else None}
+             for ta in trial_argvs])
+    else:
+        from ..subproc import run_trial
+        outcomes = []
+        for ta in trial_argvs:
+            rc, results, error = run_trial(model, ta, timeout=timeout,
+                                           env=env, python=python)
+            outcomes.append({"rc": rc, "results": results, "error": error,
+                             "worker": None})
     instances = []
-    for i in range(size):
-        rc, results, error = run_trial(
-            model,
-            list(argv) + ratio_override +
-            ["--random-seed", str(base_seed + i)],
-            timeout=timeout, env=env, python=python)
-        entry = {"instance": i, "seed": base_seed + i, "rc": rc}
-        if results is not None:
-            entry["results"] = results
+    for i, out in enumerate(outcomes):
+        entry = {"instance": i, "seed": base_seed + i, "rc": out["rc"]}
+        if out.get("worker") is not None:
+            entry["worker"] = out["worker"]
+        if out.get("results") is not None:
+            entry["results"] = out["results"]
         else:
-            entry["error"] = error
+            entry["error"] = out.get("error")
         instances.append(entry)
         if not silent:
-            print("ensemble instance %d/%d: rc=%d %s" % (
-                i + 1, size, rc,
+            print("ensemble instance %d/%d%s: rc=%s %s" % (
+                i + 1, size,
+                " (worker %s)" % out["worker"] if out.get("worker")
+                else "", out["rc"],
                 entry.get("results", entry.get("error", ""))))
     summary = aggregate(instances)
     out = {"model": model, "size": size, "train_ratio": train_ratio,

@@ -268,12 +268,26 @@ class Population:
                            self.rand, self.choices)]
 
     # -- evolve -------------------------------------------------------------
-    def evolve(self, evaluate):
+    def evolve(self, evaluate, evaluate_many=None):
         """One generation: returns True while the population keeps
-        improving and max_generations is not exhausted."""
-        for c in self.chromosomes:
-            if c.fitness is None:
-                c.fitness = evaluate(c)
+        improving and max_generations is not exhausted.
+
+        ``evaluate_many(chromosomes) -> [fitness]`` , when given, scores a
+        whole cohort in one call — the hook the cross-host trial
+        scheduler uses to farm a generation over workers (the reference
+        evaluated a generation across its slaves the same way)."""
+        def run_eval(chromos):
+            todo = [c for c in chromos if c.fitness is None]
+            if not todo:
+                return
+            if evaluate_many is not None:
+                for c, fit in zip(todo, evaluate_many(todo)):
+                    c.fitness = fit
+            else:
+                for c in todo:
+                    c.fitness = evaluate(c)
+
+        run_eval(self.chromosomes)
         prev_best = self.best_fit
         parents = self.select_roulette()
         offspring = []
@@ -286,8 +300,7 @@ class Population:
             name, pts, prob = self.mutations[
                 self.rand.randint(0, len(self.mutations))]
             child.mutate(name, pts, prob)
-        for c in offspring:
-            c.fitness = evaluate(c)
+        run_eval(offspring)
         pool = self.chromosomes + offspring
         pool.sort(key=lambda c: -c.fitness)
         self.chromosomes = pool[:self.size]

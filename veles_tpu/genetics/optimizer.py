@@ -40,8 +40,11 @@ class GeneticsOptimizer:
     def __init__(self, model=None, config=None, evaluator=None, size=10,
                  generations=None, fitness_key="best_validation_error_pt",
                  minimize=True, argv=(), rand=None, python=None,
-                 timeout=None, silent=False, env=None):
+                 timeout=None, silent=False, env=None, scheduler=None):
         self.env = env
+        #: optional jobserver.JobMaster: farm each generation's trials to
+        #: connected workers instead of running them serially in-process
+        self.scheduler = scheduler
         self.model = model
         self.config_node = config if config is not None else root
         self.evaluator = evaluator
@@ -94,14 +97,49 @@ class GeneticsOptimizer:
 
     def _evaluate_subprocess(self, assignments):
         from ..subproc import run_trial
-        argv = self.argv + ["%s=%r" % (path, value)
-                            for path, value in assignments.items()]
-        rc, result, error = run_trial(self.model, argv,
+        rc, result, error = run_trial(self.model,
+                                      self._trial_argv_for(assignments),
                                       timeout=self.timeout, env=self.env,
                                       python=self.python)
+        # failed trial = worst possible fitness (the reference raised
+        # EvaluationError and dropped the chromosome)
+        return self._fitness_from(result, error)
+
+    def _trial_argv_for(self, assignments):
+        return self.argv + ["%s=%r" % (path, value)
+                            for path, value in assignments.items()]
+
+    def _evaluate_many(self, chromos):
+        """Score a cohort by farming one CLI trial per chromosome to the
+        scheduler's workers (reference: one chromosome per slave job,
+        server.py:369-430)."""
+        payloads = []
+        for c in chromos:
+            assignments = self.overrides_for(c)
+            c.config_snapshot = assignments
+            payloads.append({"kind": "trial", "model": self.model,
+                             "argv": self._trial_argv_for(assignments),
+                             "timeout": self.timeout,
+                             "env": dict(self.env) if self.env else None})
+        # per-trial timeouts are enforced by run_trial on the worker; the
+        # cohort as a whole gets no deadline (a queue longer than the
+        # worker count must not fail legitimate trials)
+        outcomes = self.scheduler.map(payloads)
+        fits = []
+        for c, out in zip(chromos, outcomes):
+            self.trials += 1
+            fit = self._fitness_from(out.get("results"), out.get("error"))
+            if fit > -float("inf") and not self.silent:
+                print("trial %d (worker %s): %s -> fitness %.6f" % (
+                    self.trials, out.get("worker"), c.config_snapshot,
+                    fit))
+            fits.append(fit)
+        return fits
+
+    def _fitness_from(self, result, error):
+        """Shared result-JSON -> fitness conversion for the serial and
+        scheduler paths."""
         if result is None:
-            # failed trial = worst possible fitness (the reference raised
-            # EvaluationError and dropped the chromosome)
             return self._trial_failed(error)
         try:
             value = float(result[self.fitness_key])
@@ -122,7 +160,9 @@ class GeneticsOptimizer:
     def run(self):
         """Evolve until max_generations (or, when None, until the
         population stops improving — Population.patience)."""
-        while self.population.evolve(self._evaluate):
+        evaluate_many = self._evaluate_many if self.scheduler else None
+        while self.population.evolve(self._evaluate,
+                                     evaluate_many=evaluate_many):
             if not self.silent:
                 print("generation %d: best %.6f avg %.6f" % (
                     self.population.generation, self.population.best_fit,
