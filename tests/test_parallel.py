@@ -104,6 +104,83 @@ def test_dp_no_tail_recompile():
         step._eval_step_._cache_size()
 
 
+class ImageBlobLoader(BlobLoader):
+    """The blob problem reshaped to 16x16x3 images (conv TP parity)."""
+
+    def load_data(self):
+        super().load_data()
+        rng = numpy.random.RandomState(7)
+        n = len(self.original_data.mem)
+        proj = rng.uniform(-0.4, 0.4, (8, 16 * 16 * 3)).astype(
+            numpy.float32)
+        self.original_data.mem = (
+            self.original_data.mem @ proj).reshape(n, 16, 16, 3)
+
+
+CONV_LAYERS = [
+    {"type": "conv_str", "->": {"n_kernels": 8, "kx": 3, "ky": 3,
+                                "padding": 1},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+    {"type": "max_pooling", "->": {"kx": 2, "ky": 2, "sliding": (2, 2)}},
+    {"type": "conv_str", "->": {"n_kernels": 16, "kx": 3, "ky": 3,
+                                "padding": 1},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+    {"type": "avg_pooling", "->": {"kx": 2, "ky": 2, "sliding": (2, 2)}},
+    {"type": "softmax", "->": {"output_sample_shape": 4},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+]
+
+
+def build_conv(mesh=None, model_axis=None, max_epochs=2, minibatch=40,
+               seed=23):
+    import veles_tpu.prng.random_generator as rg
+    rg._generators.clear()
+    rg.get(0).seed(seed)
+    wf = StandardWorkflow(
+        None, name="par-conv",
+        loader_factory=ImageBlobLoader,
+        loader={"minibatch_size": minibatch,
+                "prng": RandomGenerator().seed(5)},
+        layers=CONV_LAYERS, loss_function="softmax",
+        decision={"max_epochs": max_epochs, "silent": True},
+        fused=True, mesh=mesh, model_axis=model_axis)
+    wf.initialize(device=Device(backend="cpu"))
+    return wf
+
+
+def test_tp_conv_equals_dp():
+    """Tensor parallelism on a CONV stack (4-D kernels split on their
+    output-channel dim over ``model``) must match pure DP — the north
+    star (AlexNet) is a conv model, so "model parallelism" has to mean
+    more than sharding the classifier."""
+    wf_d = build_conv(mesh=make_mesh({"data": 8}))
+    wf_t = build_conv(mesh=make_mesh({"data": 4, "model": 2}),
+                      model_axis="model")
+    wf_d.run()
+    wf_t.run()
+    for fd, ft in zip(wf_d.forwards, wf_t.forwards):
+        if not fd.params:
+            continue
+        assert numpy.allclose(fd.weights.map_read(), ft.weights.map_read(),
+                              atol=2e-5), type(fd).__name__
+    assert wf_d.decision.best_n_err_pt == pytest.approx(
+        wf_t.decision.best_n_err_pt, abs=1e-9)
+
+
+def test_conv_kernel_sharding_spec():
+    """4-D conv kernels split dim 3 (output channels) over ``model``;
+    odd channel counts replicate."""
+    mesh = make_mesh({"data": 4, "model": 2})
+    params = [{"weights": numpy.zeros((3, 3, 3, 8)),
+               "bias": numpy.zeros(8)},
+              {"weights": numpy.zeros((3, 3, 8, 5)),
+               "bias": numpy.zeros(5)}]
+    shard = tensor_parallel_sharding(mesh, params, "model")
+    assert tuple(shard[0]["weights"].spec) == (None, None, None, "model")
+    assert tuple(shard[0]["bias"].spec) == ("model",)
+    assert tuple(shard[1]["weights"].spec) == ()  # 5 % 2 != 0
+
+
 def test_tensor_parallel_sharding_specs():
     """2-D weights split their output dim over the model axis; odd shapes
     replicate."""

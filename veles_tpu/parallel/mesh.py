@@ -54,20 +54,23 @@ def data_parallel_sharding(mesh, params_tree):
 
 
 def tensor_parallel_sharding(mesh, params_tree, model_axis="model"):
-    """Column-split tensor parallelism: 2-D weights split their *output*
-    dim on ``model`` (and matching 1-D biases likewise); everything else
-    replicates.  XLA then gathers activations before the next layer's
-    matmul — one collective per layer.  (A Megatron alternating
-    column/row scheme would halve the collectives; tracked as a future
-    optimization.)"""
+    """Column-split tensor parallelism: weights split their *output*
+    dim on ``model`` — 2-D FC weights on dim 1, 4-D conv kernels
+    (ky, kx, c_in, n_kernels) on the kernel dim 3 (so each model-shard
+    computes a slice of the output channels; XLA partitions the conv and
+    gathers activations before the next layer — one collective per
+    layer), 1-D biases on dim 0.  Everything indivisible replicates.
+    (A Megatron alternating column/row scheme would halve the
+    collectives; tracked as a future optimization.)"""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     def spec(p):
-        if getattr(p, "ndim", 0) == 2 and p.shape[1] % mesh.shape[
-                model_axis] == 0:
+        ndim = getattr(p, "ndim", 0)
+        if ndim == 2 and p.shape[1] % mesh.shape[model_axis] == 0:
             return NamedSharding(mesh, P(None, model_axis))
-        if getattr(p, "ndim", 0) == 1 and p.shape[0] % mesh.shape[
-                model_axis] == 0:
+        if ndim == 4 and p.shape[3] % mesh.shape[model_axis] == 0:
+            return NamedSharding(mesh, P(None, None, None, model_axis))
+        if ndim == 1 and p.shape[0] % mesh.shape[model_axis] == 0:
             return NamedSharding(mesh, P(model_axis))
         return NamedSharding(mesh, P())
     import jax
