@@ -34,9 +34,19 @@ class DistributedTrainStep(FusedTrainStep):
     def initialize(self, device=None, **kwargs):
         super().initialize(device=device, **kwargs)
         import jax
+        import numpy
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         m = self.mesh
+        multihost = jax.process_count() > 1
+        if multihost:
+            # cross-process placement accepts HOST data (every process
+            # holds the same full value — loaders are identically
+            # seeded); single-device jax.Arrays cannot be resharded to a
+            # global sharding outside jit
+            self._params_ = jax.tree.map(numpy.asarray, self._params_)
+            self._opt_ = jax.tree.map(numpy.asarray, self._opt_)
+            self._macc_ = jax.tree.map(numpy.asarray, self._macc_)
         if self.model_axis and self.model_axis in m.shape:
             param_shard = mesh_mod.tensor_parallel_sharding(
                 m, self._params_, self.model_axis)
@@ -76,3 +86,24 @@ class DistributedTrainStep(FusedTrainStep):
                           scalar),
             out_shardings=(scalar, scalar, batch_shard),
             donate_argnums=(1,))
+        if multihost:
+            # multi-host: the per-step minibatch leaves the loader as a
+            # process-local array; place it onto the global batch
+            # sharding (same bytes on every process) before the SPMD call
+            inner_train, inner_eval = self._train_step_, self._eval_step_
+
+            def _global(x, shard):
+                return jax.device_put(numpy.asarray(x), shard)
+
+            def train_mh(params, opt, macc, x, y, size, seed, lr_scale):
+                return inner_train(params, opt, macc,
+                                   _global(x, batch_shard),
+                                   _global(y, label_shard),
+                                   size, seed, lr_scale)
+
+            def eval_mh(params, macc, x, y, size):
+                return inner_eval(params, macc, _global(x, batch_shard),
+                                  _global(y, label_shard), size)
+
+            self._train_step_ = train_mh
+            self._eval_step_ = eval_mh
