@@ -68,6 +68,141 @@ def test_image_transforms(tmp_path):
     assert numpy.allclose(m[2], m[0][:, ::-1])  # mirrored twin
 
 
+class _ImgLoader(FileImageLoader):
+    MAPPING = "imgtest_loader2"
+
+
+def _one_image(tmp_path, arr, name="0.png"):
+    from PIL import Image
+    d = os.path.join(str(tmp_path), "x")
+    os.makedirs(d, exist_ok=True)
+    Image.fromarray(arr).save(os.path.join(d, name))
+    return str(tmp_path)
+
+
+def test_image_rotations_inflate_and_rotate(tmp_path):
+    """rotations=(0, π/2): every image becomes 2 samples, the second a
+    90° CCW rotation (reference samples_inflation, image.py:294-313)."""
+    rng = numpy.random.RandomState(3)
+    src = rng.randint(0, 255, (10, 10, 3), numpy.uint8)
+    base = _one_image(tmp_path, src)
+    wf = Workflow(None)
+    ld = _ImgLoader(wf, train_paths=[base],
+                    rotations=(0.0, numpy.pi / 2), minibatch_size=2)
+    ld.load_data()
+    m = numpy.asarray(ld.original_data.mem)
+    assert m.shape == (2, 10, 10, 3)
+    assert numpy.allclose(m[1], numpy.rot90(m[0]), atol=1.0)
+    assert ld.original_labels == ["x", "x"]
+
+
+def test_image_multi_crop_smart_and_random(tmp_path):
+    rng = numpy.random.RandomState(4)
+    src = rng.randint(0, 255, (16, 16, 3), numpy.uint8)
+    base = _one_image(tmp_path, src)
+    wf = Workflow(None)
+    ld = _ImgLoader(wf, train_paths=[base], crop=(8, 8), crop_number=3,
+                    minibatch_size=2)
+    ld.load_data()
+    m = numpy.asarray(ld.original_data.mem)
+    assert m.shape == (3, 8, 8, 3)
+    # smart crops spread evenly: first at (0,0), last at (8,8)
+    assert numpy.array_equal(m[0], src[:8, :8].astype(numpy.float32))
+    assert numpy.array_equal(m[2], src[8:, 8:].astype(numpy.float32))
+    # random crops are reproducible under the seeded loader prng
+    crops = []
+    for _ in range(2):
+        ld2 = _ImgLoader(Workflow(None), train_paths=[base], crop=(8, 8),
+                         crop_number=3, smart_crop=False,
+                         minibatch_size=2,
+                         prng=RandomGenerator().seed(11))
+        ld2.load_data()
+        crops.append(numpy.asarray(ld2.original_data.mem))
+    assert numpy.array_equal(crops[0], crops[1])
+
+
+def test_image_random_mirror_is_seeded(tmp_path):
+    rng = numpy.random.RandomState(5)
+    for i in range(6):
+        _one_image(tmp_path, rng.randint(0, 255, (8, 8, 3), numpy.uint8),
+                   name="%d.png" % i)
+    runs = []
+    for _ in range(2):
+        ld = _ImgLoader(Workflow(None), train_paths=[str(tmp_path)],
+                        mirror="random", minibatch_size=2,
+                        prng=RandomGenerator().seed(7))
+        ld.load_data()
+        runs.append(numpy.asarray(ld.original_data.mem))
+    assert numpy.array_equal(runs[0], runs[1])
+    assert len(runs[0]) == 6  # no inflation, flips are in place
+
+
+def test_image_sobel_channel(tmp_path):
+    """add_sobel appends an edge-magnitude channel: a hard vertical edge
+    lights up, flat regions stay dark (reference image.py:384,433)."""
+    src = numpy.zeros((12, 12, 3), numpy.uint8)
+    src[:, 6:] = 200
+    base = _one_image(tmp_path, src)
+    ld = _ImgLoader(Workflow(None), train_paths=[base], add_sobel=True,
+                    minibatch_size=2)
+    ld.load_data()
+    m = numpy.asarray(ld.original_data.mem)
+    assert m.shape == (1, 12, 12, 4)
+    sob = m[0, :, :, 3]
+    assert sob[6, 6] > 100        # on the edge
+    assert sob[6, 2] == 0         # flat region
+    assert sob[6, 10] == 0
+
+
+def test_image_color_space_and_filters(tmp_path):
+    rng = numpy.random.RandomState(6)
+    _one_image(tmp_path, rng.randint(0, 255, (8, 8, 3), numpy.uint8),
+               name="keep_1.png")
+    _one_image(tmp_path, rng.randint(0, 255, (8, 8, 3), numpy.uint8),
+               name="skip_2.png")
+    ld = _ImgLoader(Workflow(None), train_paths=[str(tmp_path)],
+                    color_space="HSV", ignored_files=(r"skip.*",),
+                    minibatch_size=2)
+    ld.load_data()
+    m = numpy.asarray(ld.original_data.mem)
+    assert m.shape == (1, 8, 8, 3)  # filter dropped skip_2
+    from PIL import Image
+    expected = numpy.asarray(Image.open(
+        os.path.join(str(tmp_path), "x", "keep_1.png")).convert("HSV"))
+    assert numpy.array_equal(m[0], expected.astype(numpy.float32))
+
+
+def test_image_mse_pairs_stay_aligned(tmp_path):
+    """ImageLoaderMSE replays every augmentation on the target image:
+    with mirror expansion and multi-crop, input k and target k must be
+    the SAME transform of their source pair (reference image_mse.py)."""
+    from veles_tpu.loader.image import FileImageLoaderMSE
+    rng = numpy.random.RandomState(8)
+    src = rng.randint(0, 255, (16, 16, 3), numpy.uint8)
+    tgt = 255 - src
+    from PIL import Image
+    ind = os.path.join(str(tmp_path), "in")
+    td = os.path.join(str(tmp_path), "tgt")
+    os.makedirs(ind)
+    os.makedirs(td)
+    Image.fromarray(src).save(os.path.join(ind, "a.png"))
+    Image.fromarray(tgt).save(os.path.join(td, "a.png"))
+
+    class L(FileImageLoaderMSE):
+        MAPPING = "imgtest_mse_loader"
+
+    ld = L(Workflow(None), train_paths=[ind], target_paths=[td],
+           crop=(8, 8), crop_number=2, mirror=True, minibatch_size=2,
+           prng=RandomGenerator().seed(9))
+    ld.load_data()
+    data = numpy.asarray(ld.original_data.mem)
+    targets = numpy.asarray(ld.original_targets.mem)
+    assert data.shape == targets.shape == (4, 8, 8, 3)  # 2 crops x mirror
+    # crop offsets and mirror applied identically: inversion must hold
+    # sample-by-sample
+    assert numpy.array_equal(targets, 255.0 - data)
+
+
 def test_pickles_loader(tmp_path):
     rng = numpy.random.RandomState(0)
     train = (rng.rand(20, 5).astype(numpy.float32),

@@ -1,52 +1,274 @@
 """Image pipeline: decode/resize/augment images into the HBM fullbatch.
 
 TPU-native re-design of /root/reference/veles/loader/image.py (~1300 LoC
-of per-minibatch PIL work) + fullbatch_image.py.  The reference decoded
-and transformed images per minibatch on the host; on TPU the host would
-then fight the device for the input pipeline, so the design decodes and
-augments ONCE at initialize into the resident FullBatch dataset (HBM),
-and the per-step path stays a fused device gather.  The capability
-surface kept: scale (factor or fixed target, aspect-preserving with
-background fill), center crop, horizontal mirror expansion, grayscale/
-RGB channel handling, background color, and the
-``get_keys``/``get_image_data``/``get_image_label`` subclass protocol
-(reference IImageLoader, image.py:83-104).
+of per-minibatch PIL/OpenCV work) + file_image.py + fullbatch_image.py +
+image_mse.py.  The reference decoded and transformed images per minibatch
+on the host; on TPU the host would then fight the device for the input
+pipeline, so the design decodes and augments ONCE at initialize into the
+resident FullBatch dataset (HBM), and the per-step path stays a fused
+device gather.  The capability surface kept from the reference:
+
+- scale (factor or fixed target), aspect-preserving letterbox with
+  background fill from a color OR a background image
+  (image.py:139-146,316-331);
+- rotations: a tuple of angles (radians) — every sample is emitted once
+  per rotation, the reference's samples_inflation (image.py:136,294-313);
+- center crop, plus ``crop_number`` > 1 multi-crops per image with
+  ``smart_crop`` (deterministic even spread) or seeded-random offsets
+  (image.py:138,254-280);
+- mirror: False | True (expand the train set with flipped copies) |
+  "random" (seeded per-sample coin flip, the static-dataset equivalent
+  of the reference's per-epoch random mirror) (image.py:283-291);
+- grayscale / color_space conversions (RGB, L/GRAY, HSV, YCbCr — PIL
+  modes; reference used OpenCV spaces, image.py:116-127);
+- ``add_sobel`` extra edge-magnitude channel (image.py:131,384,433);
+- the ``get_keys``/``get_image_data``/``get_image_label`` subclass
+  protocol (reference IImageLoader, image.py:83-104);
+- directory scanning with include/ignore regex filters
+  (file_loader.py:54-100, file_image.py:53-177);
+- image→image MSE target pairs (image_mse.py:47-126): every input
+  transform is replayed identically on the target image so augmented
+  pairs stay aligned.
+
+Mean subtraction (reference path_to_mean) is handled by the normalizer
+family (veles_tpu/normalization.py) rather than inside the loader.
 """
 
+import math
 import os
+import re
 
 import numpy
 
 from .base import TEST, VALID, TRAIN
-from .fullbatch import FullBatchLoader
+from .fullbatch import FullBatchLoader, FullBatchLoaderMSE
+
+_SOBEL_X = numpy.array([[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]],
+                       numpy.float32)
+_SOBEL_Y = _SOBEL_X.T
 
 
-class ImageLoader(FullBatchLoader):
+def sobel_magnitude(gray):
+    """|∇I| of a 2-D array via the 3x3 Sobel pair (edge-replicated)."""
+    padded = numpy.pad(gray.astype(numpy.float32), 1, mode="edge")
+    gx = numpy.zeros_like(gray, numpy.float32)
+    gy = numpy.zeros_like(gray, numpy.float32)
+    for dy in range(3):
+        for dx in range(3):
+            window = padded[dy:dy + gray.shape[0], dx:dx + gray.shape[1]]
+            gx += _SOBEL_X[dy, dx] * window
+            gy += _SOBEL_Y[dy, dx] * window
+    return numpy.hypot(gx, gy)
+
+
+class ImageTransformer:
+    """The shared decode→scale→rotate→crop→channels pipeline + the
+    variant fan-out (rotations x crops), reused by the plain and the
+    MSE image loaders."""
+
+    def _init_transforms(self, kwargs):
+        self.scale = kwargs.get("scale", 1.0)
+        self.maintain_aspect = bool(kwargs.get("maintain_aspect", True))
+        self.crop = kwargs.get("crop")
+        self.crop_number = int(kwargs.get("crop_number", 1))
+        self.smart_crop = bool(kwargs.get("smart_crop", True))
+        self.mirror = kwargs.get("mirror", False)
+        self.rotations = tuple(kwargs.get("rotations", (0.0,)))
+        for rot in self.rotations:
+            if not 0.0 <= float(rot) < 2 * math.pi:
+                raise ValueError("rotations must be radians in [0, 2π): %r"
+                                 % (rot,))
+        if self.crop_number < 1:
+            raise ValueError("crop_number must be >= 1")
+        if self.crop_number > 1 and self.crop is None:
+            raise ValueError("crop_number > 1 requires crop=(h, w)")
+        if self.mirror not in (False, True, "random"):
+            raise ValueError("mirror must be False, True or 'random'")
+        self.grayscale = bool(kwargs.get("grayscale", False))
+        self.color_space = kwargs.get(
+            "color_space", "L" if self.grayscale else "RGB")
+        if self.color_space == "GRAY":
+            self.color_space = "L"
+        self.add_sobel = bool(kwargs.get("add_sobel", False))
+        self.background_color = tuple(
+            kwargs.get("background_color", (0, 0, 0)))
+        self._background_image = kwargs.get("background_image")
+
+    @property
+    def samples_inflation(self):
+        """How many samples each source image becomes (before mirror
+        expansion): one per rotation per crop (reference image.py:311)."""
+        return len(self.rotations) * self.crop_number
+
+    # -- decoding ------------------------------------------------------------
+    def decode_image(self, key):
+        """Decode one image file to HxWxC in ``color_space``."""
+        from PIL import Image
+        with Image.open(key) as img:
+            arr = numpy.asarray(img.convert(self.color_space))
+        return arr
+
+    def _pil_of(self, arr):
+        from PIL import Image
+        if arr.ndim == 3 and arr.shape[-1] == 1:
+            arr = arr[..., 0]
+        return Image.fromarray(arr)
+
+    def _background_canvas(self, mode, size):
+        from PIL import Image
+        if self._background_image is not None:
+            bg = self._background_image
+            if isinstance(bg, str):
+                with Image.open(bg) as img:
+                    bg = numpy.asarray(img.convert(self.color_space))
+                self._background_image = bg
+            canvas = self._pil_of(numpy.asarray(bg)).convert(mode)
+            return canvas.resize(size, Image.BILINEAR)
+        bg = self.background_color
+        return Image.new(mode, size, bg[0] if mode == "L" else bg)
+
+    # -- per-image transform chain -------------------------------------------
+    def scale_image(self, data):
+        """factor/target scale, optional aspect-preserving letterbox."""
+        from PIL import Image
+        if data.ndim == 2:
+            data = data[:, :, None]
+        img = data
+        if self.scale == 1.0:
+            return img
+        if isinstance(self.scale, (tuple, list)):
+            th, tw = self.scale
+        else:
+            th = int(round(img.shape[0] * self.scale))
+            tw = int(round(img.shape[1] * self.scale))
+        pil = self._pil_of(img)
+        if self.maintain_aspect:
+            ratio = min(th / img.shape[0], tw / img.shape[1])
+            nh = max(1, int(round(img.shape[0] * ratio)))
+            nw = max(1, int(round(img.shape[1] * ratio)))
+            pil = pil.resize((nw, nh), Image.BILINEAR)
+            canvas = self._background_canvas(pil.mode, (tw, th))
+            canvas.paste(pil, ((tw - nw) // 2, (th - nh) // 2))
+            pil = canvas
+        else:
+            pil = pil.resize((tw, th), Image.BILINEAR)
+        out = numpy.asarray(pil)
+        return out[:, :, None] if out.ndim == 2 else out
+
+    def rotate_image(self, img, angle):
+        """Rotate about the center (radians, CCW), background-filled,
+        same output shape (reference rotations semantics)."""
+        if not angle:
+            return img
+        from PIL import Image
+        pil = self._pil_of(img)
+        bg = self.background_color
+        fill = bg[0] if pil.mode == "L" else tuple(bg)
+        pil = pil.rotate(math.degrees(angle), resample=Image.BILINEAR,
+                         expand=False, fillcolor=fill)
+        out = numpy.asarray(pil)
+        return out[:, :, None] if out.ndim == 2 else out
+
+    def _crop_offsets(self, shape):
+        """Offsets of the crop windows: center for 1; an even spread
+        (smart) or seeded-random positions for crop_number > 1."""
+        ch, cw = self.crop
+        maxy = max(shape[0] - ch, 0)
+        maxx = max(shape[1] - cw, 0)
+        n = self.crop_number
+        if n == 1:
+            return [(maxy // 2, maxx // 2)]
+        if self.smart_crop:
+            # deterministic even coverage along both axes
+            return [(int(round(i * maxy / (n - 1))),
+                     int(round(i * maxx / (n - 1)))) for i in range(n)]
+        return [(int(self.prng.randint(0, maxy + 1)),
+                 int(self.prng.randint(0, maxx + 1))) for _ in range(n)]
+
+    def crop_image(self, img, offset):
+        ch, cw = self.crop
+        oy, ox = offset
+        return img[oy:oy + ch, ox:ox + cw]
+
+    def finalize_channels(self, img):
+        """Optional sobel channel; float32 output."""
+        img = numpy.asarray(img, numpy.float32)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if self.add_sobel:
+            gray = img.mean(axis=-1) if img.shape[-1] > 1 else img[..., 0]
+            img = numpy.concatenate(
+                [img, sobel_magnitude(gray)[:, :, None]], axis=-1)
+        return img
+
+    def image_variants(self, data):
+        """All (rotation x crop) variants of one decoded image, in a
+        deterministic order: rotations outer, crops inner."""
+        scaled = self.scale_image(numpy.asarray(data))
+        variants = []
+        for angle in self.rotations:
+            rotated = self.rotate_image(scaled, angle)
+            if self.crop is not None:
+                for off in self._crop_offsets(rotated.shape):
+                    variants.append(
+                        self.finalize_channels(
+                            self.crop_image(rotated, off)))
+            else:
+                variants.append(self.finalize_channels(rotated))
+        return variants
+
+    # -- dataset assembly ----------------------------------------------------
+    def build_class_samples(self, keys, get_data, paired_get_data=None):
+        """Decode+transform every key; returns (samples, counts[,
+        paired samples]) where counts[i] is how many variants key i
+        produced.  ``paired_get_data`` (MSE targets) replays the exact
+        transform sequence on the paired image — crop offsets are
+        re-seeded per key so input and target crops align."""
+        samples, paired, counts = [], [], []
+        for key in keys:
+            if paired_get_data is not None and not self.smart_crop and \
+                    self.crop_number > 1:
+                state = self.prng.state
+            variants = self.image_variants(get_data(key))
+            samples.extend(variants)
+            counts.append(len(variants))
+            if paired_get_data is not None:
+                if not self.smart_crop and self.crop_number > 1:
+                    self.prng.state = state
+                paired.extend(self.image_variants(paired_get_data(key)))
+        if paired_get_data is not None:
+            return samples, counts, paired
+        return samples, counts
+
+    def apply_mirror(self, cls, samples, labels, paired=None):
+        """mirror=True: append flipped copies (TRAIN only — flipped eval
+        samples would distort validation metrics); mirror="random":
+        seeded per-sample coin flip in place."""
+        if self.mirror is True and cls == TRAIN:
+            samples += [s[:, ::-1].copy() for s in samples]
+            labels += list(labels)
+            if paired is not None:
+                paired += [t[:, ::-1].copy() for t in paired]
+        elif self.mirror == "random":
+            for i in range(len(samples)):
+                if self.prng.randint(0, 2):
+                    samples[i] = samples[i][:, ::-1].copy()
+                    if paired is not None:
+                        paired[i] = paired[i][:, ::-1].copy()
+
+
+class ImageLoader(ImageTransformer, FullBatchLoader):
     """FullBatch loader whose samples come from decoded images.
 
-    kwargs:
-      scale: float factor or (height, width) target size;
-      maintain_aspect: letterbox into the target with background fill
-        (reference scale_maintain_aspect_ratio);
-      crop: (height, width) center crop after scaling;
-      mirror: False | True — True EXPANDS the train set with horizontally
-        flipped copies (the static-dataset equivalent of the reference's
-        per-epoch "random" mirror);
-      grayscale: collapse to one channel;
-      background_color: RGB fill for letterboxing.
-    """
+    See the module docstring for the transform surface; subclasses
+    implement the reference IImageLoader protocol: ``get_keys``,
+    ``get_image_label``, and optionally ``get_image_data``."""
 
     hide_from_registry = True
 
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)
-        self.scale = kwargs.get("scale", 1.0)
-        self.maintain_aspect = bool(kwargs.get("maintain_aspect", True))
-        self.crop = kwargs.get("crop")
-        self.mirror = kwargs.get("mirror", False)
-        self.grayscale = bool(kwargs.get("grayscale", False))
-        self.background_color = tuple(
-            kwargs.get("background_color", (0, 0, 0)))
+        self._init_transforms(kwargs)
 
     # -- subclass protocol (reference IImageLoader) --------------------------
     def get_keys(self, class_index):
@@ -57,49 +279,12 @@ class ImageLoader(FullBatchLoader):
         raise NotImplementedError
 
     def get_image_data(self, key):
-        """Decode one image to an HxWxC uint8/float array."""
-        from PIL import Image
-        with Image.open(key) as img:
-            return numpy.asarray(img.convert(
-                "L" if self.grayscale else "RGB"))
+        """Decode one image to an HxWxC array (``color_space``)."""
+        return self.decode_image(key)
 
-    # -- transforms ----------------------------------------------------------
     def transform_image(self, data):
-        """scale → crop → channel handling; returns float32 HxWxC."""
-        from PIL import Image
-        if data.ndim == 2:
-            data = data[:, :, None]
-        img = data
-        if self.scale != 1.0:
-            if isinstance(self.scale, (tuple, list)):
-                th, tw = self.scale
-            else:
-                th = int(round(img.shape[0] * self.scale))
-                tw = int(round(img.shape[1] * self.scale))
-            pil = Image.fromarray(img.squeeze(-1) if img.shape[-1] == 1
-                                  else img)
-            if self.maintain_aspect:
-                ratio = min(th / img.shape[0], tw / img.shape[1])
-                nh = max(1, int(round(img.shape[0] * ratio)))
-                nw = max(1, int(round(img.shape[1] * ratio)))
-                pil = pil.resize((nw, nh), Image.BILINEAR)
-                bg = self.background_color
-                canvas = Image.new(
-                    pil.mode, (tw, th),
-                    bg[0] if pil.mode == "L" else bg)
-                canvas.paste(pil, ((tw - nw) // 2, (th - nh) // 2))
-                pil = canvas
-            else:
-                pil = pil.resize((tw, th), Image.BILINEAR)
-            img = numpy.asarray(pil)
-            if img.ndim == 2:
-                img = img[:, :, None]
-        if self.crop is not None:
-            ch, cw = self.crop
-            oy = max((img.shape[0] - ch) // 2, 0)
-            ox = max((img.shape[1] - cw) // 2, 0)
-            img = img[oy:oy + ch, ox:ox + cw]
-        return numpy.asarray(img, numpy.float32)
+        """First (rotation, crop) variant — kept for API compatibility."""
+        return self.image_variants(data)[0]
 
     # -- FullBatch integration -----------------------------------------------
     def load_data(self):
@@ -107,14 +292,12 @@ class ImageLoader(FullBatchLoader):
         labels_per_class = {}
         for cls in (TEST, VALID, TRAIN):
             keys = list(self.get_keys(cls))
-            samples, labels = [], []
-            for key in keys:
-                samples.append(self.transform_image(
-                    self.get_image_data(key)))
-                labels.append(self.get_image_label(key))
-            if cls == TRAIN and self.mirror and samples:
-                samples += [s[:, ::-1].copy() for s in samples]
-                labels += list(labels)
+            samples, counts = self.build_class_samples(
+                keys, self.get_image_data)
+            labels = []
+            for key, n in zip(keys, counts):
+                labels += [self.get_image_label(key)] * n
+            self.apply_mirror(cls, samples, labels)
             data_per_class[cls] = samples
             labels_per_class[cls] = labels
         all_samples = (data_per_class[TEST] + data_per_class[VALID] +
@@ -134,21 +317,102 @@ class ImageLoader(FullBatchLoader):
             self.class_lengths[cls] = len(data_per_class[cls])
 
 
-class FileImageLoader(ImageLoader):
+class ImageLoaderMSE(ImageTransformer, FullBatchLoaderMSE):
+    """Image→image regression pairs (reference image_mse.py): inputs and
+    targets are decoded images, and every augmentation (scale, rotation,
+    crops, mirror) is replayed identically on the target so the pairs
+    stay aligned.  Subclasses implement ``get_keys``/``get_image_data``
+    plus ``get_target_key`` (input key → target key) or override
+    ``get_target_data`` directly."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self._init_transforms(kwargs)
+
+    def get_keys(self, class_index):
+        raise NotImplementedError
+
+    def get_target_key(self, key):
+        """Map an input image key to its target image key."""
+        raise NotImplementedError
+
+    def get_image_data(self, key):
+        return self.decode_image(key)
+
+    def get_target_data(self, key):
+        return self.decode_image(self.get_target_key(key))
+
+    def load_data(self):
+        data_per_class = {}
+        targets_per_class = {}
+        for cls in (TEST, VALID, TRAIN):
+            keys = list(self.get_keys(cls))
+            samples, _counts, targets = self.build_class_samples(
+                keys, self.get_image_data,
+                paired_get_data=self.get_target_data)
+            labels = []  # MSE: labels unused
+            self.apply_mirror(cls, samples, labels, paired=targets)
+            data_per_class[cls] = samples
+            targets_per_class[cls] = targets
+        all_samples = (data_per_class[TEST] + data_per_class[VALID] +
+                       data_per_class[TRAIN])
+        if not all_samples:
+            raise ValueError("no images found by get_keys")
+        self.original_data.mem = numpy.stack(all_samples)
+        self.original_targets.mem = numpy.stack(
+            targets_per_class[TEST] + targets_per_class[VALID] +
+            targets_per_class[TRAIN])
+        for cls in (TEST, VALID, TRAIN):
+            self.class_lengths[cls] = len(data_per_class[cls])
+
+
+class FileFilterMixin:
+    """Directory scanning with include/ignore regex filters (reference
+    file_loader.py FileFilter: included_files/ignored_files)."""
+
+    EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".gif")
+
+    def _init_filters(self, kwargs):
+        self._included = [re.compile(p) for p in
+                          kwargs.get("included_files", (".*",))]
+        self._ignored = [re.compile(p) for p in
+                         kwargs.get("ignored_files", ())]
+
+    def is_valid_filename(self, fname):
+        if os.path.splitext(fname)[1].lower() not in self.EXTENSIONS:
+            return False
+        if not any(p.match(fname) for p in self._included):
+            return False
+        return not any(p.match(fname) for p in self._ignored)
+
+    def scan_directories(self, bases):
+        keys = []
+        for base in bases:
+            for dirpath, _dirs, files in sorted(os.walk(base)):
+                for fname in sorted(files):
+                    if self.is_valid_filename(fname):
+                        keys.append(os.path.join(dirpath, fname))
+        return keys
+
+
+class FileImageLoader(FileFilterMixin, ImageLoader):
     """Directory-tree image loader: labels from subdirectory names.
 
-    (reference file_image.py / FileListImageLoader role.)
+    (reference file_image.py FileImageLoader/AutoLabelFileImageLoader.)
 
     kwargs ``test_paths``/``validation_paths``/``train_paths``: lists of
     directories whose immediate subdirectories name the labels, e.g.
     ``train/cat/1.png``; flat directories label every file with the
-    directory's own basename."""
+    directory's own basename.  ``included_files``/``ignored_files``:
+    regex lists filtering filenames (reference FileFilter)."""
 
     MAPPING = "file_image_loader"
-    EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".gif")
 
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)
+        self._init_filters(kwargs)
         self.class_paths = {
             TEST: list(kwargs.get("test_paths", ())),
             VALID: list(kwargs.get("validation_paths", ())),
@@ -156,14 +420,42 @@ class FileImageLoader(ImageLoader):
         }
 
     def get_keys(self, class_index):
-        keys = []
-        for base in self.class_paths[class_index]:
-            for dirpath, _dirs, files in sorted(os.walk(base)):
-                for fname in sorted(files):
-                    if os.path.splitext(fname)[1].lower() in \
-                            self.EXTENSIONS:
-                        keys.append(os.path.join(dirpath, fname))
-        return keys
+        return self.scan_directories(self.class_paths[class_index])
 
     def get_image_label(self, key):
         return os.path.basename(os.path.dirname(key))
+
+
+class FileImageLoaderMSE(FileFilterMixin, ImageLoaderMSE):
+    """Directory-scanning image→image pairs: inputs under
+    ``*_paths``, targets resolved by basename under ``target_paths``
+    (reference file_image.py FileImageLoaderMSEMixin: target_paths +
+    basename matching)."""
+
+    MAPPING = "file_image_loader_mse"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self._init_filters(kwargs)
+        self.class_paths = {
+            TEST: list(kwargs.get("test_paths", ())),
+            VALID: list(kwargs.get("validation_paths", ())),
+            TRAIN: list(kwargs.get("train_paths", ())),
+        }
+        self.target_paths = list(kwargs.get("target_paths", ()))
+        self._target_index = None
+
+    def get_keys(self, class_index):
+        return self.scan_directories(self.class_paths[class_index])
+
+    def get_target_key(self, key):
+        if self._target_index is None:
+            self._target_index = {}
+            for tkey in self.scan_directories(self.target_paths):
+                self._target_index[os.path.basename(tkey)] = tkey
+        base = os.path.basename(key)
+        try:
+            return self._target_index[base]
+        except KeyError:
+            raise ValueError("no target image named %r under %s"
+                             % (base, self.target_paths))
