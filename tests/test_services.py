@@ -58,6 +58,58 @@ def test_rest_api_live_workflow(trained):
         api.stop()
 
 
+def test_rest_api_evaluation_transform(trained):
+    """A non-trivial evaluation_transform shapes the served answer
+    (reference restful_api.py evaluation hook) — here top-2 classes
+    with their probabilities."""
+    def top2(out):
+        e = numpy.exp(out - out.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        idx = numpy.argsort(-p, axis=1)[:, :2]
+        return [{"classes": row.tolist(),
+                 "probs": p[i, row].round(4).tolist()}
+                for i, row in enumerate(idx)]
+
+    api = RESTfulAPI(trained, port=0, evaluation_transform=top2)
+    try:
+        x = numpy.asarray(
+            trained.loader.original_data.map_read()[:2]).tolist()
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/api" % api.port,
+            json.dumps({"input": x}).encode(),
+            {"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req).read())
+        assert len(resp["result"]) == 2
+        for entry in resp["result"]:
+            assert len(entry["classes"]) == 2
+            assert entry["probs"][0] >= entry["probs"][1] > 0
+            # transform result must agree with the raw output rows
+        raw = numpy.asarray(resp["output"])
+        assert raw.shape == (2, 10)
+        for i, entry in enumerate(resp["result"]):
+            assert entry["classes"][0] == int(raw[i].argmax())
+    finally:
+        api.stop()
+
+
+def test_rest_api_off_host_bind(trained):
+    """host= is honored: binding all interfaces still answers on
+    loopback (the reference served off-host; our default stays
+    loopback-private)."""
+    api = RESTfulAPI(trained, port=0, host="0.0.0.0")
+    try:
+        x = numpy.asarray(
+            trained.loader.original_data.map_read()[:1]).tolist()
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/api" % api.port,
+            json.dumps({"input": x}).encode(),
+            {"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req).read())
+        assert len(resp["result"]) == 1
+    finally:
+        api.stop()
+
+
 def test_rest_api_from_package(trained, tmp_path):
     from veles_tpu.export import export_model
     path = str(tmp_path / "pkg.zip")

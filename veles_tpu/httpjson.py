@@ -1,0 +1,36 @@
+"""Shared JSON-over-HTTP plumbing for the serving endpoints.
+
+Both the standalone inference endpoint (restful_api.py) and the
+live-workflow input loader (loader/restful.py) speak the same protocol —
+``POST /api {"input": ...}`` answered with JSON — so the request
+parsing/validation and response writing live here once.
+"""
+
+import json
+from http.server import BaseHTTPRequestHandler
+
+import numpy
+
+
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Quiet handler with JSON helpers and the /api input contract."""
+
+    def log_message(self, *args):
+        pass
+
+    def send_json(self, code, payload):
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def read_input_payload(self):
+        """Parse the request body as {"input": ...} → float32 array.
+        Raises ValueError with a client-presentable message."""
+        length = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(length))
+        if not isinstance(payload, dict) or "input" not in payload:
+            raise ValueError("body must be {'input': [...]}")
+        return numpy.asarray(payload["input"], numpy.float32)

@@ -6,7 +6,13 @@ Reference: /root/reference/veles/loader/ (base protocol at base.py:100-120).
 from .base import (Loader, LoaderError, TEST, VALID, TRAIN, CLASS_NAME,
                    TRIAGE)                                  # noqa: F401
 from .fullbatch import FullBatchLoader, FullBatchLoaderMSE  # noqa: F401
-from .image import ImageLoader, FileImageLoader  # noqa: F401
+from .image import (ImageLoader, FileImageLoader,           # noqa: F401
+                    ImageLoaderMSE, FileImageLoaderMSE)
 from .pickles import (PicklesLoader, Hdf5Loader,            # noqa: F401
                       FileListLoader)
 from .saver import MinibatchesSaver, MinibatchesLoader      # noqa: F401
+from .stream import StreamLoader                            # noqa: F401
+from .sound import SndFileLoader                            # noqa: F401
+from .interactive import InteractiveLoader                  # noqa: F401
+from .restful import RestfulLoader, RestfulResponder        # noqa: F401
+from .hdfs import HdfsTextLoader, WebHdfsClient             # noqa: F401

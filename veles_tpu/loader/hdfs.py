@@ -1,0 +1,109 @@
+"""HDFS text streaming via the WebHDFS REST gateway.
+
+Re-creation of /root/reference/veles/loader/hdfs_loader.py
+(HDFSTextLoader:48-70): the reference streamed text lines from HDFS in
+fixed-size chunks through the snakebite RPC client.  That client (and
+libhdfs) is a heavy external dependency; every HDFS deployment also
+exposes the WebHDFS REST API, which speaks plain HTTP — so this build
+talks WebHDFS with stdlib urllib only: dependency-free, and testable
+against a stub HTTP server the same way the reference network stack was
+tested in-process.
+
+Protocol: ``GET {url}/webhdfs/v1{path}?op=GETFILESTATUS`` for stat,
+``?op=OPEN`` (redirect-following) for content, ``?op=LISTSTATUS`` for
+directory listings.
+"""
+
+import json
+import urllib.parse
+import urllib.request
+
+from ..mutable import Bool
+from ..units import Unit
+
+
+class WebHdfsClient:
+    """Minimal WebHDFS REST client (stdlib-only)."""
+
+    def __init__(self, url, user=None, timeout=30.0):
+        self.base = url.rstrip("/")
+        self.user = user
+        self.timeout = timeout
+
+    def _url(self, path, op, **params):
+        if not path.startswith("/"):
+            path = "/" + path
+        params["op"] = op
+        if self.user:
+            params["user.name"] = self.user
+        return "%s/webhdfs/v1%s?%s" % (
+            self.base, urllib.parse.quote(path),
+            urllib.parse.urlencode(params))
+
+    def status(self, path):
+        with urllib.request.urlopen(self._url(path, "GETFILESTATUS"),
+                                    timeout=self.timeout) as r:
+            return json.load(r)["FileStatus"]
+
+    def list(self, path):
+        with urllib.request.urlopen(self._url(path, "LISTSTATUS"),
+                                    timeout=self.timeout) as r:
+            statuses = json.load(r)["FileStatuses"]["FileStatus"]
+        return [s["pathSuffix"] for s in statuses]
+
+    def text(self, path, encoding="utf-8"):
+        """Iterate the file's lines (OPEN follows the datanode
+        redirect automatically via urllib)."""
+        with urllib.request.urlopen(self._url(path, "OPEN"),
+                                    timeout=self.timeout) as r:
+            tail = b""
+            while True:
+                block = r.read(1 << 16)
+                if not block:
+                    break
+                tail += block
+                *lines, tail = tail.split(b"\n")
+                for line in lines:
+                    yield line.decode(encoding)
+            if tail:
+                yield tail.decode(encoding)
+
+
+class HdfsTextLoader(Unit):
+    """Stream an HDFS text file in fixed-size line chunks.
+
+    Each run() fills ``output`` with the next ``chunk`` lines (the
+    final partial chunk sets ``chunk_size`` < chunk) and raises
+    ``finished`` when the file is exhausted — the reference
+    HDFSTextLoader contract."""
+
+    MAPPING = "hdfs_text_loader"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.file_name = kwargs["file"]
+        self.chunk_lines_number = int(kwargs.get("chunk", 1000))
+        self.hdfs_client = kwargs.get("client") or WebHdfsClient(
+            kwargs["url"], user=kwargs.get("user"),
+            timeout=kwargs.get("timeout", 30.0))
+        self.output = [""] * self.chunk_lines_number
+        self.chunk_size = 0
+        self.finished = Bool(False)
+        self._generator = None
+
+    def initialize(self, **kwargs):
+        super().initialize(**kwargs)
+        # stat first: a missing path fails loudly at initialize, not
+        # midway through the stream (reference did the same, :62)
+        self.file_status = self.hdfs_client.status(self.file_name)
+        self._generator = self.hdfs_client.text(self.file_name)
+
+    def run(self):
+        assert not self.finished
+        self.chunk_size = 0
+        try:
+            for i in range(self.chunk_lines_number):
+                self.output[i] = next(self._generator)
+                self.chunk_size += 1
+        except StopIteration:
+            self.finished <<= True

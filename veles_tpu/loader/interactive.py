@@ -1,0 +1,62 @@
+"""InteractiveLoader: hand-feed a running workflow from code/REPL.
+
+Re-creation of /root/reference/veles/loader/interactive.py (:57-127):
+the reference blocked its workflow until the user ``feed()``-ed an
+object — a numpy array, a text stream for ``numpy.loadtxt``, a file
+path, or a URL — and served it as one minibatch, optionally deriving
+normalization from a trained loader (``derive_from``).  Here it rides
+the StreamLoader queue (the transport-agnostic serving input path), so
+the same workflow can be driven from the shell (interaction.py) or a
+notebook while keeping the normal unit protocol.  URL download is
+delegated to the Downloader unit rather than re-implemented.
+"""
+
+import io
+import os
+
+import numpy
+
+from .stream import StreamLoader
+
+
+class InteractiveLoader(StreamLoader):
+    """Serves objects fed interactively; each feed is one minibatch."""
+
+    MAPPING = "interactive_loader"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self._loadtxt_kwargs = dict(kwargs.get("loadtxt_kwargs", {}))
+        self._normalizer = None
+
+    def derive_from(self, loader):
+        """Copy the trained loader's normalization (and sample shape if
+        unset), so interactive samples go through the same preprocessing
+        the model was trained with (reference interactive.py:185-200)."""
+        self._normalizer = getattr(loader, "normalizer", None)
+        if not self.sample_shape:
+            shape = getattr(loader, "minibatch_data", None)
+            if shape is not None and shape.shape:
+                self.sample_shape = tuple(shape.shape[1:])
+        return self
+
+    def feed(self, obj, labels=None):
+        """Accepts a numpy array / nested list, a text file path, or an
+        open text stream (numpy.loadtxt); single samples are promoted to
+        a batch of one."""
+        if isinstance(obj, str):
+            if not os.path.exists(obj):
+                raise ValueError(
+                    "no such file: %r (URLs go through the Downloader "
+                    "unit)" % obj)
+            with open(obj) as f:
+                obj = numpy.loadtxt(f, **self._loadtxt_kwargs)
+        elif isinstance(obj, io.IOBase):
+            obj = numpy.loadtxt(obj, **self._loadtxt_kwargs)
+        arr = numpy.asarray(obj, numpy.float32)
+        if self.sample_shape and arr.shape == tuple(self.sample_shape):
+            arr = arr[None]  # single sample convenience
+        if self._normalizer is not None:
+            arr = arr.copy()
+            self._normalizer.normalize(arr)
+        super().feed(arr, labels)

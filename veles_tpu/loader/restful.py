@@ -1,0 +1,199 @@
+"""RestfulLoader: an HTTP input path INTO a live workflow.
+
+Re-creation of /root/reference/veles/loader/restful.py (:52-131) + the
+loader half of restful_api.py: the reference batched concurrent HTTP
+requests into one minibatch (flushing when full or when
+``max_response_time`` elapsed), ran the workflow's own forward graph on
+it, and answered every request with its output row.  This is distinct
+from :class:`veles_tpu.restful_api.RESTfulAPI`, which serves a separate
+jitted forward; the loader path exercises the LIVE workflow — its
+normalization, its units, its observables.
+
+Pieces:
+- :class:`RestfulLoader` — StreamLoader whose producer is an embedded
+  stdlib HTTP server; requests accumulate under a lock and flush to the
+  workflow queue when a minibatch fills or the response timer fires.
+- :class:`RestfulResponder` — the unit linked after the last forward;
+  hands the output rows back to the waiting HTTP threads.
+
+Protocol (same shape as the serving endpoint):
+    POST /api {"input": [...sample...]}  → {"result": r, "output": [...]}
+"""
+
+import queue as queue_mod
+import threading
+from http.server import ThreadingHTTPServer
+
+import numpy
+
+from ..httpjson import JsonRequestHandler
+from ..units import Unit
+from .base import TEST
+from .stream import StreamLoader
+
+
+class _Request:
+    __slots__ = ("sample", "event", "output", "error")
+
+    def __init__(self, sample):
+        self.sample = sample
+        self.event = threading.Event()
+        self.output = None
+        self.error = None
+
+
+class RestfulLoader(StreamLoader):
+    """Feed the workflow from HTTP requests, batched reference-style."""
+
+    MAPPING = "restful_loader"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.port = int(kwargs.get("port", 0))
+        self.max_response_time = float(
+            kwargs.get("max_response_time", 0.05))
+        if self.max_response_time < 0:
+            raise ValueError("max_response_time must be >= 0")
+        self.response_timeout = float(
+            kwargs.get("response_timeout", 30.0))
+        self._pending = []
+        self._plock = threading.Lock()
+        self._inflight = []
+        self._httpd = None
+        self._flusher = None
+        self._closing = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        if self._httpd is None:
+            handler = type("Handler", (_Handler,), {"loader": self})
+            self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                              handler)
+            self.port = self._httpd.server_address[1]
+            threading.Thread(target=self._httpd.serve_forever,
+                             daemon=True, name="restful-loader").start()
+            self._flusher = threading.Thread(
+                target=self._flush_loop, daemon=True,
+                name="restful-loader-flush")
+            self._flusher.start()
+
+    def close(self):
+        self._closing.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        super().close()
+
+    # -- request intake ------------------------------------------------------
+    def submit(self, sample):
+        """HTTP thread: enqueue one sample, return its pending request.
+
+        Shape is validated HERE, before the sample can reach the batch:
+        one malformed request must get its own 400, never a stack/
+        reshape error on the workflow or flusher thread."""
+        arr = numpy.asarray(sample, numpy.float32)
+        want = tuple(self.sample_shape)
+        if arr.shape != want:
+            if arr.size != int(numpy.prod(want)):
+                raise ValueError(
+                    "sample shape %s does not match the workflow's %s"
+                    % (arr.shape, want))
+            arr = arr.reshape(want)
+        req = _Request(arr)
+        with self._plock:
+            self._pending.append(req)
+            if len(self._pending) >= self.max_minibatch_size:
+                self._flush_locked()
+        return req
+
+    def _flush_loop(self):
+        while not self._closing.wait(self.max_response_time):
+            with self._plock:
+                self._flush_locked()
+
+    def _flush_locked(self):
+        if not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        data = numpy.stack([r.sample for r in batch])
+        self.queue.put((data, batch))
+
+    # -- Loader protocol -----------------------------------------------------
+    def run(self):
+        self._inflight = []
+        try:
+            item = self.queue.get(timeout=self.timeout)
+        except queue_mod.Empty:
+            self.minibatch_size = 0
+            return
+        if item is None:  # close(): stop the workflow loop
+            self.finished = True
+            self.stopped = True
+            if self._workflow is not None:
+                self._workflow.stop()
+            return
+        data, reqs = item
+        n = len(data)
+        self.minibatch_size = n
+        self.minibatch_class = TEST
+        mem = self.minibatch_data.map_write()
+        mem[:n] = data.reshape((n,) + tuple(self.sample_shape))
+        if n < self.max_minibatch_size:
+            mem[n:] = 0
+        self._inflight = list(reqs)
+        self.samples_served += n
+
+    def respond(self, outputs):
+        """Responder side: route output row i to waiting request i."""
+        reqs, self._inflight = self._inflight, []
+        outputs = numpy.asarray(outputs)
+        for i, req in enumerate(reqs):
+            if i < len(outputs):
+                req.output = outputs[i]
+            else:
+                req.error = "workflow produced no output row"
+            req.event.set()
+
+
+class RestfulResponder(Unit):
+    """Link after the last forward: flushes its ``input`` rows back to
+    the loader's waiting HTTP requests."""
+
+    MAPPING = "restful_responder"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.loader = kwargs.get("loader")
+        self.input = None  # link_attrs from the last forward's output
+
+    def run(self):
+        out = self.input.map_read() if hasattr(self.input, "map_read") \
+            else numpy.asarray(self.input)
+        self.loader.respond(numpy.asarray(out)[:self.loader.minibatch_size])
+
+
+class _Handler(JsonRequestHandler):
+    loader = None
+
+    def do_POST(self):
+        if self.path != "/api":
+            self.send_json(404, {"error": "not found"})
+            return
+        try:
+            sample = self.read_input_payload()
+            req = self.loader.submit(sample)
+        except Exception as e:  # client errors must get a JSON answer
+            self.send_json(400, {"error": str(e)})
+            return
+        if not req.event.wait(self.loader.response_timeout):
+            self.send_json(504, {"error": "workflow response timeout"})
+            return
+        if req.error:
+            self.send_json(500, {"error": req.error})
+            return
+        out = numpy.asarray(req.output)
+        result = int(out.argmax()) if out.ndim == 1 and len(out) > 1 \
+            else out.tolist()
+        self.send_json(200, {"result": result, "output": out.tolist()})

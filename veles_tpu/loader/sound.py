@@ -1,0 +1,119 @@
+"""Audio loader: WAV files → fixed-length windows in the HBM fullbatch.
+
+Re-creation of /root/reference/veles/loader/libsndfile_loader.py: the
+reference decoded audio through a ctypes libsndfile binding
+(libsndfile.py) into normalized float arrays and scanned directories via
+FileListLoaderBase.  This build decodes with the stdlib ``wave`` module
+(PCM 8/16/32-bit WAV — the formats the reference's own tests used);
+libsndfile's exotic formats (FLAC/OGG) are environment-gated the same
+way LMDB is.  Decoded tracks are sliced into fixed ``window`` sample
+frames so the result is a normal FullBatch dataset: resident in HBM,
+gather-in-step, any Znicz topology on top.
+"""
+
+import os
+
+import numpy
+
+from .base import TEST, VALID, TRAIN
+from .fullbatch import FullBatchLoader
+from .image import FileFilterMixin
+
+
+def decode_wav(path, mono=True):
+    """Decode a PCM WAV file to float32 in [-1, 1]; (frames, channels)
+    or (frames,) when ``mono`` mixes the channels down."""
+    import wave
+    with wave.open(path, "rb") as w:
+        n_channels = w.getnchannels()
+        width = w.getsampwidth()
+        frames = w.readframes(w.getnframes())
+        rate = w.getframerate()
+    if width == 1:      # unsigned 8-bit
+        data = (numpy.frombuffer(frames, numpy.uint8).astype(numpy.float32)
+                - 128.0) / 128.0
+    elif width == 2:    # signed 16-bit
+        data = numpy.frombuffer(frames, "<i2").astype(
+            numpy.float32) / 32768.0
+    elif width == 4:    # signed 32-bit
+        data = numpy.frombuffer(frames, "<i4").astype(
+            numpy.float32) / 2147483648.0
+    else:
+        raise ValueError("unsupported WAV sample width %d in %s"
+                         % (width, path))
+    data = data.reshape(-1, n_channels)
+    if mono:
+        data = data.mean(axis=1)
+    return data, rate
+
+
+class SndFileLoader(FileFilterMixin, FullBatchLoader):
+    """Directory-scanning audio loader: labels from subdirectory names,
+    one sample per ``window``-frame slice of each track.
+
+    kwargs:
+      test_paths/validation_paths/train_paths: directory lists, labels
+        from the immediate parent directory (as FileImageLoader);
+      included_files/ignored_files: regex filename filters (the shared
+        FileFilterMixin contract);
+      window: frames per sample (required);
+      hop: stride between windows (default = window, non-overlapping);
+      mono: mix channels down (default True);
+      pad_tail: zero-pad the last partial window instead of dropping it.
+    """
+
+    MAPPING = "sndfile_loader"
+    EXTENSIONS = (".wav",)
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self._init_filters(kwargs)
+        self.window = int(kwargs["window"])
+        self.hop = int(kwargs.get("hop", self.window))
+        if self.window < 1 or self.hop < 1:
+            raise ValueError("window and hop must be >= 1")
+        self.mono = bool(kwargs.get("mono", True))
+        self.pad_tail = bool(kwargs.get("pad_tail", False))
+        self.class_paths = {
+            TEST: list(kwargs.get("test_paths", ())),
+            VALID: list(kwargs.get("validation_paths", ())),
+            TRAIN: list(kwargs.get("train_paths", ())),
+        }
+        self.sampling_rates = {}
+
+    def get_keys(self, class_index):
+        return self.scan_directories(self.class_paths[class_index])
+
+    def get_label(self, key):
+        return os.path.basename(os.path.dirname(key))
+
+    def windows_of(self, key):
+        """Slice one decoded track into (n, window[, channels]) floats."""
+        data, rate = decode_wav(key, mono=self.mono)
+        self.sampling_rates[key] = rate
+        spans = []
+        pos = 0
+        while pos + self.window <= len(data):
+            spans.append(data[pos:pos + self.window])
+            pos += self.hop
+        if self.pad_tail and pos < len(data):
+            tail = data[pos:]
+            pad = [(0, self.window - len(tail))] + \
+                [(0, 0)] * (tail.ndim - 1)
+            spans.append(numpy.pad(tail, pad))
+        return numpy.asarray(spans, numpy.float32)
+
+    def load_data(self):
+        samples, labels = [], []
+        for cls in (TEST, VALID, TRAIN):
+            count = 0
+            for key in self.get_keys(cls):
+                wins = self.windows_of(key)
+                samples.extend(wins)
+                labels += [self.get_label(key)] * len(wins)
+                count += len(wins)
+            self.class_lengths[cls] = count
+        if not samples:
+            raise ValueError("no WAV files found under the given paths")
+        self.original_data.mem = numpy.stack(samples)
+        self.original_labels = labels

@@ -15,23 +15,28 @@ Protocol (reference-compatible shape):
     → {"result": [...], "output": [[...]]}
 """
 
-import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 
 import numpy
+
+from .httpjson import JsonRequestHandler
 
 
 class RESTfulAPI:
     """Serve a trained model over HTTP."""
 
-    def __init__(self, model, port=0, evaluation_transform=None):
+    def __init__(self, model, port=0, evaluation_transform=None,
+                 host="127.0.0.1"):
         """``model``: a StandardWorkflow (live forwards) or a
-        PackageLoader / path to a package zip."""
+        PackageLoader / path to a package zip.  ``host``: bind address —
+        the loopback default keeps the model private; pass "0.0.0.0" to
+        serve off-host (the reference served on all interfaces,
+        restful_api.py:78)."""
         self._transform = evaluation_transform
         self._infer = self._build_infer(model)
         handler = type("Handler", (_Handler,), {"api": self})
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
@@ -66,33 +71,18 @@ class RESTfulAPI:
         self._httpd.server_close()
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JsonRequestHandler):
     api = None
-
-    def log_message(self, *args):
-        pass
-
-    def _send(self, code, payload):
-        data = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
 
     def do_POST(self):
         if self.path != "/api":
-            self._send(404, {"error": "not found"})
+            self.send_json(404, {"error": "not found"})
             return
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length))
-            if not isinstance(payload, dict) or "input" not in payload:
-                raise ValueError("body must be {'input': [...]}")
-            batch = numpy.asarray(payload["input"], numpy.float32)
+            batch = self.read_input_payload()
             if batch.ndim == 1:
                 batch = batch[None]  # single sample convenience
             result, out = self.api.infer(batch)
-            self._send(200, {"result": result, "output": out.tolist()})
+            self.send_json(200, {"result": result, "output": out.tolist()})
         except Exception as e:  # client errors must get a JSON answer
-            self._send(400, {"error": str(e)})
+            self.send_json(400, {"error": str(e)})
