@@ -1,0 +1,114 @@
+"""Compensated GEMM (VERDICT round-2 item 7): the Pallas blocked matmul
+must reproduce the reference's PRECISION_LEVEL contract
+(/root/reference/ocl/matrix_multiplication_precise.cl:37-48) — level 1
+beats level 0 accuracy on an ill-conditioned problem, level 2 is at
+least as good — with parity against an f64 reference in interpret mode,
+and differentiable so trainers can use it."""
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.znicz.gemm import _matmul_impl, precise_matmul
+
+
+def _f64(a, b):
+    return a.astype(numpy.float64) @ b.astype(numpy.float64)
+
+
+def test_matches_f64_on_well_conditioned():
+    rng = numpy.random.RandomState(0)
+    a = rng.standard_normal((100, 300)).astype(numpy.float32)
+    b = rng.standard_normal((300, 50)).astype(numpy.float32)
+    ref = _f64(a, b)
+    for lvl in (0, 1, 2):
+        out = numpy.asarray(precise_matmul(a, b, lvl))
+        assert out.shape == (100, 50)
+        assert numpy.abs(out - ref).max() < 1e-4, lvl
+
+
+def test_blocking_is_exact_across_tiles():
+    """Padded/tiled edges (shapes far from multiples of the blocks) must
+    not change the math."""
+    rng = numpy.random.RandomState(1)
+    a = rng.standard_normal((130, 70)).astype(numpy.float32)
+    b = rng.standard_normal((70, 190)).astype(numpy.float32)
+    out = numpy.asarray(_matmul_impl(a, b, 1, True, block_m=64,
+                                     block_n=64, block_k=32))
+    assert numpy.abs(out - _f64(a, b)).max() < 1e-4
+
+
+def _cancellation_problem(bk=256):
+    """Summands arranged so huge cross-tile cancellation brackets small
+    contributions: plain f32 accumulation absorbs (and loses) the small
+    tiles into the big partial sums."""
+    rng = numpy.random.RandomState(1)
+    K = 4 * bk
+    row = numpy.zeros(K, numpy.float32)
+    row[0:bk] = 3e7
+    row[bk:2 * bk] = rng.uniform(-1, 1, bk)
+    row[2 * bk:3 * bk] = -3e7
+    row[3 * bk:] = rng.uniform(-1, 1, bk)
+    a = numpy.tile(row[None, :], (8, 1))
+    b = numpy.ones((K, 8), numpy.float32)
+    return a, b
+
+
+def test_level1_beats_level0_on_cancellation():
+    """The VERDICT 'done' criterion: compensated summation recovers what
+    plain blocked accumulation destroys."""
+    a, b = _cancellation_problem()
+    ref = _f64(a, b)
+    errs = {lvl: numpy.abs(numpy.asarray(
+        _matmul_impl(a, b, lvl, True, block_k=256)) - ref).max()
+        for lvl in (0, 1, 2)}
+    assert errs[0] > 0.1, errs          # plain blocking really does lose it
+    assert errs[1] < errs[0] / 1e4, errs
+    assert errs[2] <= errs[1] * 1.01, errs
+
+
+def test_gradients_flow_at_every_level():
+    rng = numpy.random.RandomState(2)
+    a = jnp.asarray(rng.standard_normal((32, 64)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)
+    for lvl in (0, 1, 2):
+        ga, gb = jax.grad(
+            lambda a, b: (precise_matmul(a, b, lvl) ** 2).sum(),
+            argnums=(0, 1))(a, b)
+        ref = 2 * (a @ b)
+        assert numpy.allclose(numpy.asarray(ga), numpy.asarray(ref @ b.T),
+                              atol=1e-3), lvl
+        assert numpy.allclose(numpy.asarray(gb), numpy.asarray(a.T @ ref),
+                              atol=1e-3), lvl
+
+
+def test_all2all_precise_gemm_opt_in():
+    """All2All(precise_gemm=N) routes its matmul through the kernel and
+    stays numerically consistent with the default path."""
+    from veles_tpu.memory import Array
+    from veles_tpu.backends import Device
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.znicz.all2all import All2All
+    rng = numpy.random.RandomState(3)
+    x = rng.standard_normal((16, 24)).astype(numpy.float32)
+    outs = []
+    for precise in (0, 1):
+        wf = Workflow(name="pg")
+        u = All2All(wf, output_sample_shape=8, precise_gemm=precise,
+                    prng=RandomGenerator().seed(4))
+        u.input = Array(x.copy())
+        u.initialize(device=Device(backend="cpu"))
+        u.run()
+        outs.append(numpy.asarray(u.output.map_read()))
+    assert numpy.allclose(outs[0], outs[1], atol=1e-5)
+    assert not numpy.array_equal(outs[0], numpy.zeros_like(outs[0]))
+
+
+def test_bad_shapes_raise():
+    a = numpy.zeros((4, 5), numpy.float32)
+    b = numpy.zeros((6, 3), numpy.float32)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        precise_matmul(a, b, 1)

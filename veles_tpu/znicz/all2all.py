@@ -30,6 +30,12 @@ class All2All(ForwardBase):
             shape = (shape,)
         self.output_sample_shape = tuple(shape)
         self.activation = activations.get(self.ACTIVATION)
+        # opt-in compensated-summation GEMM (the reference's
+        # PRECISION_LEVEL 1/2, znicz/gemm.py); 0 = XLA matmul, whose
+        # pass-count already follows Device precision_level
+        from ..config import root
+        self.precise_gemm = int(kwargs.get(
+            "precise_gemm", root.common.engine.get("precise_gemm", 0)))
 
     @property
     def neurons_number(self):
@@ -49,7 +55,11 @@ class All2All(ForwardBase):
     def apply(self, params, x):
         import jax.numpy as jnp
         x = x.reshape(x.shape[0], -1)
-        y = x @ params["weights"]
+        if self.precise_gemm:
+            from .gemm import precise_matmul
+            y = precise_matmul(x, params["weights"], self.precise_gemm)
+        else:
+            y = x @ params["weights"]
         if "bias" in params:
             y = y + params["bias"]
         y = self.activation.fwd_jnp(y)

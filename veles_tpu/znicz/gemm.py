@@ -1,0 +1,168 @@
+"""Compensated blocked GEMM: the reference's PRECISION_LEVEL semantics,
+as a Pallas TPU kernel.
+
+Re-creation of /root/reference/ocl/matrix_multiplication_precise.cl
+(:37-48 contract, :119-170 accumulators): the reference's GEMM offered
+PRECISION_LEVEL 0 (plain summation), 1 (Kahan summation), 2 ("most
+precise": 32 sorted partials) — trading ~2x speed for ~2 more correct
+decimal digits on large common dims.
+
+TPU redesign: scalar-loop Kahan cannot ride the MXU (the systolic array
+owns the inner products), so compensation moves to the BLOCK level — the
+K dimension is tiled, each tile's partial product comes out of the MXU
+in f32, and the running accumulation of tiles into the output block is
+compensated in VMEM:
+
+- level 0: plain ``acc += p`` (same blocking, uncompensated — the
+  baseline the tests compare against);
+- level 1: Kahan (one compensation term per output element);
+- level 2: Kahan-Babuška-Neumaier second order (Klein's doubly
+  compensated summation, two carry terms) — the 32-partial analog.
+
+Intra-tile error (bk-length MXU chains) remains — that part of the
+reference guarantee is hardware-owned on TPU (f32 MXU accumulation);
+cross-tile cancellation, which dominates for large K, is what the
+compensation recovers.  ``jax.config`` keeps XLA's algebraic rewrites
+away from the compensation expressions (XLA does not reassociate floats
+by default).
+
+The jnp/XLA fallback for remote-compile backends stays in
+``backends.Device.PRECISION_LEVELS`` (the MXU pass-decomposition knob);
+this kernel is the opt-in exact-summation path
+(``root.common.engine.precise_gemm`` or ``All2All(precise_gemm=N)``).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+def _accumulate_plain(p, acc_ref, _c1_ref, _c2_ref):
+    acc_ref[:] = acc_ref[:] + p
+
+
+def _accumulate_kahan(p, acc_ref, c1_ref, _c2_ref):
+    # Kahan-Babuška-Neumaier: the rounding error of every (acc + p) is
+    # carried in c1.  (Classic Kahan drops its compensation whenever a
+    # summand exceeds the accumulator — exactly the cross-tile
+    # cancellation case this kernel exists for — so the Neumaier form
+    # is the honest "PRECISION_LEVEL 1".)
+    s, e = _two_sum(acc_ref[:], p)
+    acc_ref[:] = s
+    c1_ref[:] = c1_ref[:] + e
+
+
+def _two_sum(a, b):
+    """Knuth's exact TwoSum: a + b = s + e with e the rounding error."""
+    s = a + b
+    v = s - a
+    e = (a - (s - v)) + (b - v)
+    return s, e
+
+
+def _accumulate_klein(p, acc_ref, c1_ref, c2_ref):
+    # Doubly compensated (Kahan-Babuška-Neumaier 2nd order): the error
+    # of the main sum cascades into c1, c1's own error into c2
+    s, e = _two_sum(acc_ref[:], p)
+    c1, e2 = _two_sum(c1_ref[:], e)
+    acc_ref[:] = s
+    c1_ref[:] = c1
+    c2_ref[:] = c2_ref[:] + e2
+
+
+_ACCUMULATORS = {0: _accumulate_plain, 1: _accumulate_kahan,
+                 2: _accumulate_klein}
+
+
+def _matmul_impl(a, b, level, interpret, block_m=128, block_n=128,
+                 block_k=256):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _interpret_default()
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    m, k = a.shape
+    k2, n = b.shape
+    if k != k2:
+        raise ValueError("shape mismatch %s @ %s" % (a.shape, b.shape))
+    bm, bn, bk = min(block_m, m), min(block_n, n), min(block_k, k)
+    pad_m, pad_n, pad_k = (-m) % bm, (-n) % bn, (-k) % bk
+    if pad_m or pad_k:
+        a = jnp.pad(a, ((0, pad_m), (0, pad_k)))
+    if pad_k or pad_n:
+        b = jnp.pad(b, ((0, pad_k), (0, pad_n)))
+    grid = (a.shape[0] // bm, b.shape[1] // bn, a.shape[1] // bk)
+    accumulate = _ACCUMULATORS[int(level)]
+    k_steps = grid[2]
+
+    def kernel(a_ref, b_ref, o_ref, acc_ref, c1_ref, c2_ref):
+        kk = pl.program_id(2)
+
+        @pl.when(kk == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            c1_ref[:] = jnp.zeros_like(c1_ref)
+            c2_ref[:] = jnp.zeros_like(c2_ref)
+
+        # HIGHEST = exact-f32 tile products (6-pass bf16 decomposition
+        # on the MXU, plain f32 in interpret mode).  The reference's
+        # levels all multiplied exact floats and differed only in the
+        # SUMMATION (matrix_multiplication_precise.cl:37-48); default
+        # precision here would drown the compensation in bf16 product
+        # noise
+        p = jnp.dot(a_ref[:], b_ref[:],
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)
+        accumulate(p, acc_ref, c1_ref, c2_ref)
+
+        @pl.when(kk == k_steps - 1)
+        def _():
+            # fold the carries back in (zero for level 0)
+            o_ref[:] = acc_ref[:] + (c1_ref[:] + c2_ref[:])
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+                  pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j))],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct(
+            (a.shape[0], b.shape[1]), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)] * 3,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(a, b)
+    if pad_m or pad_n:
+        out = out[:m, :n]
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def precise_matmul(a, b, level=1, interpret=None):
+    """``a @ b`` with compensated cross-tile accumulation (see module
+    docstring).  Differentiable: the backward matmuls run at the same
+    precision level."""
+    return _matmul_impl(a, b, level, interpret)
+
+
+def _pm_fwd(a, b, level, interpret):
+    return _matmul_impl(a, b, level, interpret), (a, b)
+
+
+def _pm_bwd(level, interpret, res, g):
+    a, b = res
+    return (_matmul_impl(g, jnp.asarray(b, jnp.float32).T, level,
+                         interpret),
+            _matmul_impl(jnp.asarray(a, jnp.float32).T, g, level,
+                         interpret))
+
+
+precise_matmul.defvjp(_pm_fwd, _pm_bwd)
