@@ -5,18 +5,35 @@ MNIST, MnistSimple, MnistAE, CIFAR10, AlexNet, STL10, Kohonen...)."""
 def build_standard(cfg, name, default_loader_factory, loss_function,
                    **overrides):
     """Shared config-merge for the StandardWorkflow samples: defaults
-    from the sample's config namespace, overridden per call."""
+    from the sample's config namespace, overridden per call.  Topology
+    comes from ``layers`` OR the ``mcdnnic_topology`` string (with
+    ``mcdnnic_parameters``), whichever the config/overrides provide."""
     from ..standard_workflow import StandardWorkflow
+    from ...config import Config
     decision = cfg.decision.todict()
     decision.update(overrides.pop("decision", {}))
     loader = cfg.loader.todict()
     loader.update(overrides.pop("loader", {}))
-    layers = overrides.pop("layers", cfg.layers)
+    topology = {}
+    mcdnnic = overrides.pop("mcdnnic_topology",
+                            cfg.get("mcdnnic_topology"))
+    if "layers" in overrides:
+        topology["layers"] = overrides.pop("layers")
+        overrides.pop("mcdnnic_parameters", None)
+    elif mcdnnic:
+        params = overrides.pop("mcdnnic_parameters",
+                               cfg.get("mcdnnic_parameters"))
+        if isinstance(params, Config):
+            params = params.todict()
+        topology = {"mcdnnic_topology": mcdnnic,
+                    "mcdnnic_parameters": params}
+    else:
+        topology["layers"] = cfg.layers
     if "snapshotter" in cfg and "snapshotter" not in overrides:
         overrides["snapshotter"] = cfg.snapshotter.todict()
     return StandardWorkflow(
         None, name=name,
         loader_factory=overrides.pop("loader_factory",
                                      default_loader_factory),
-        loader=loader, layers=layers, loss_function=loss_function,
-        decision=decision, **overrides)
+        loader=loader, loss_function=loss_function,
+        decision=decision, **topology, **overrides)

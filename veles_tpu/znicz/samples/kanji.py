@@ -1,0 +1,99 @@
+"""Kanji: glyph-denoising MSE workflow (target = clean class glyph).
+
+Re-creation of the Znicz Kanji sample (absent submodule; named in the
+reference's sample inventory, SURVEY.md §2.9).  The reference trained an
+MLP to map distorted renderings of Japanese characters onto their CLEAN
+target glyphs — an image→image MSE task where many noisy instances share
+one target (loader/image_mse.py machinery).  Real font rendering needs
+fontconfig assets the build env lacks; the loader synthesizes glyph
+classes as deterministic stroke patterns, then emits jittered noisy
+instances as inputs with the clean pattern as the MSE target — the same
+many-to-one target structure.
+"""
+
+import numpy
+
+from ...config import root
+from ...loader.fullbatch import FullBatchLoaderMSE
+from ...loader.base import TEST, VALID, TRAIN
+
+_LR = {"learning_rate": 3e-3, "gradient_moment": 0.9}
+SIDE = 24
+
+root.kanji.update({
+    "loader": {"minibatch_size": 50,
+               "normalization_type": "range_linear",
+               "target_normalization_type": "range_linear"},
+    "layers": [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 120,
+                                        "weights_stddev": 0.05},
+         "<-": _LR},
+        {"type": "all2all", "->": {"output_sample_shape": SIDE * SIDE,
+                                   "weights_stddev": 0.05}, "<-": _LR},
+    ],
+    "decision": {"max_epochs": 40, "fail_iterations": 20},
+})
+
+
+def make_glyphs(n_classes, side=SIDE, seed=53):
+    """Deterministic stroke-pattern 'glyphs', one per class."""
+    rng = numpy.random.RandomState(seed)
+    glyphs = numpy.zeros((n_classes, side, side), numpy.float32)
+    for c in range(n_classes):
+        for _ in range(rng.randint(3, 7)):  # a few strokes each
+            if rng.randint(2):
+                r = rng.randint(2, side - 2)
+                a, b = sorted(rng.randint(0, side, 2))
+                glyphs[c, r, a:b + 1] = 1.0
+            else:
+                col = rng.randint(2, side - 2)
+                a, b = sorted(rng.randint(0, side, 2))
+                glyphs[c, a:b + 1, col] = 1.0
+    return glyphs
+
+
+class KanjiLoader(FullBatchLoaderMSE):
+    """Noisy jittered glyph instances → clean glyph targets."""
+
+    MAPPING = "kanji_loader"
+
+    def __init__(self, workflow, **kwargs):
+        self.n_classes = kwargs.pop("n_classes", 16)
+        self.n_train = kwargs.pop("n_train", 800)
+        self.n_valid = kwargs.pop("n_valid", 200)
+        super().__init__(workflow, **kwargs)
+
+    def load_data(self):
+        glyphs = make_glyphs(self.n_classes)
+        rng = numpy.random.RandomState(54)
+
+        def make(n):
+            labels = rng.randint(0, self.n_classes, n)
+            data = glyphs[labels].copy()
+            for i in range(n):
+                data[i] = numpy.roll(
+                    numpy.roll(data[i], rng.randint(-2, 3), 0),
+                    rng.randint(-2, 3), 1)
+            data += rng.normal(0, 0.25, data.shape)
+            return (numpy.clip(data, 0, 1.5).reshape(n, -1),
+                    glyphs[labels].reshape(n, -1), labels)
+
+        vd, vt, vl = make(self.n_valid)
+        td, tt, tl = make(self.n_train)
+        self.original_data.mem = numpy.concatenate([vd, td])
+        self.original_targets.mem = numpy.concatenate([vt, tt])
+        self.original_labels = list(numpy.concatenate([vl, tl]))
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = self.n_valid
+        self.class_lengths[TRAIN] = self.n_train
+
+
+def create_workflow(fused=True, **overrides):
+    from . import build_standard
+    return build_standard(root.kanji, "Kanji", KanjiLoader, "mse",
+                          fused=fused, **overrides)
+
+
+def run(load, main):
+    load(create_workflow)
+    main()

@@ -1,0 +1,79 @@
+"""Lines: orientation classification, topology via mcdnnic notation.
+
+Re-creation of the Znicz Lines sample (absent submodule): the reference's
+documented user of ``mcdnnic_topology``
+(/root/reference/docs/source/manualrst_veles_workflow_creation.rst:41-47
+points at veles.znicz.samples.Lines.lines) — a small convnet classifying
+images of straight lines by orientation, with the whole topology given
+as one MCDNN string and per-layer defaults via ``mcdnnic_parameters``.
+
+The reference trained on downloaded line photos; the loader here draws
+deterministic synthetic lines in 4 orientations (horizontal, vertical,
+the two diagonals) with noise and jitter — same task shape, zero egress.
+"""
+
+import numpy
+
+from ...config import root
+from ...loader.fullbatch import FullBatchLoader
+from ...loader.base import TEST, VALID, TRAIN
+
+root.lines.update({
+    "loader": {"minibatch_size": 40, "normalization_type": "mean_disp"},
+    "mcdnnic_topology": "1x32x32-8C5-MP2-16C5-MP2-64N-4N",
+    "mcdnnic_parameters": {
+        "->": {"weights_stddev": 0.1},
+        "<-": {"learning_rate": 0.05, "gradient_moment": 0.9},
+    },
+    "decision": {"max_epochs": 10, "fail_iterations": 20},
+})
+
+
+def draw_line(orientation, side=32, rng=None):
+    img = numpy.zeros((side, side, 1), numpy.float32)
+    off = rng.randint(-side // 4, side // 4 + 1) if rng is not None else 0
+    idx = numpy.arange(side)
+    if orientation == 0:      # horizontal
+        img[numpy.clip(side // 2 + off, 0, side - 1), :, 0] = 1.0
+    elif orientation == 1:    # vertical
+        img[:, numpy.clip(side // 2 + off, 0, side - 1), 0] = 1.0
+    elif orientation == 2:    # main diagonal
+        img[idx, numpy.clip(idx + off, 0, side - 1), 0] = 1.0
+    else:                     # anti-diagonal
+        img[idx, numpy.clip(side - 1 - idx + off, 0, side - 1), 0] = 1.0
+    if rng is not None:
+        img[:, :, 0] += rng.normal(0, 0.1, (side, side))
+    return img
+
+
+class LinesLoader(FullBatchLoader):
+    MAPPING = "lines_loader"
+
+    def __init__(self, workflow, **kwargs):
+        self.n_train = kwargs.pop("n_train", 400)
+        self.n_valid = kwargs.pop("n_valid", 100)
+        super().__init__(workflow, **kwargs)
+
+    def load_data(self):
+        rng = numpy.random.RandomState(17)
+        data, labels = [], []
+        for i in range(self.n_valid + self.n_train):
+            orientation = i % 4
+            data.append(draw_line(orientation, rng=rng))
+            labels.append(orientation)
+        self.original_data.mem = numpy.stack(data)
+        self.original_labels = labels
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = self.n_valid
+        self.class_lengths[TRAIN] = self.n_train
+
+
+def create_workflow(fused=True, **overrides):
+    from . import build_standard
+    return build_standard(root.lines, "Lines", LinesLoader, "softmax",
+                          fused=fused, **overrides)
+
+
+def run(load, main):
+    load(create_workflow)
+    main()

@@ -31,6 +31,64 @@ from .decision import DecisionGD, DecisionMSE
 from .fused import FusedTrainStep
 
 
+def parse_mcdnnic_topology(topology, parameters=None):
+    """MCDNN string notation → a ``layers`` config list.
+
+    The reference accepted topologies "like in the AlexNet paper"
+    (manualrst_veles_workflow_creation.rst:41-47, used by the Lines
+    sample): dash-separated tokens, e.g. ``12x256x256-32C5-MP2-64C5-
+    MP2-1024N-10N``:
+
+    - ``CxHxW`` (first token, optional) — the input spec, informational;
+    - ``<n>C<k>`` — conv, n kernels of k x k (strict-ReLU);
+    - ``MP<k>`` / ``AP<k>`` — max/avg pooling k x k, stride k;
+    - ``<n>N`` — fully-connected with n neurons; tanh for hidden
+      layers, softmax for the final one.
+
+    ``parameters`` ({"->": {...}, "<-": {...}} or flat) seeds every
+    generated layer's config (the reference's ``mcdnnic_parameters``)."""
+    import re
+    params = dict(parameters or {})
+    fwd_base = dict(params.get("->", {}))
+    gd_base = dict(params.get("<-", {}))
+    flat = {k: v for k, v in params.items() if k not in ("->", "<-")}
+    tokens = [t for t in str(topology).split("-") if t]
+    if tokens and re.fullmatch(r"\d+(x\d+)+", tokens[0]):
+        tokens = tokens[1:]  # input spec: shapes come from the loader
+    layers = []
+    for i, tok in enumerate(tokens):
+        last = i == len(tokens) - 1
+        m = re.fullmatch(r"(\d+)C(\d+)", tok)
+        if m:
+            n, k = int(m.group(1)), int(m.group(2))
+            layers.append({"type": "conv_str",
+                           "->": {"n_kernels": n, "kx": k, "ky": k,
+                                  **fwd_base},
+                           "<-": dict(gd_base), **flat})
+            continue
+        m = re.fullmatch(r"(M|A)P(\d+)", tok)
+        if m:
+            k = int(m.group(2))
+            layers.append({"type": ("max_pooling" if m.group(1) == "M"
+                                    else "avg_pooling"),
+                           "->": {"kx": k, "ky": k, "sliding": (k, k)}})
+            continue
+        m = re.fullmatch(r"(\d+)N", tok)
+        if m:
+            n = int(m.group(1))
+            layers.append({"type": "softmax" if last else "all2all_tanh",
+                           "->": {"output_sample_shape": n, **fwd_base},
+                           "<-": dict(gd_base), **flat})
+            continue
+        raise ValueError(
+            "unrecognized mcdnnic token %r in %r (expected <n>C<k>, "
+            "MP<k>/AP<k>, <n>N or an CxHxW input spec)"
+            % (tok, topology))
+    if not layers:
+        raise ValueError("mcdnnic_topology %r has no layers" % topology)
+    return layers
+
+
 def _find_pair(type_name):
     """Resolve a layer-type MAPPING to its (forward, gd) classes via the
     unit registry (the reference resolves through its own MAPPING registry,
@@ -55,7 +113,15 @@ class StandardWorkflow(Workflow):
 
     def __init__(self, workflow=None, **kwargs):
         super().__init__(workflow, **kwargs)
-        self.layers_config = list(kwargs.get("layers", ()))
+        if kwargs.get("mcdnnic_topology"):
+            if kwargs.get("layers"):
+                raise ValueError(
+                    "pass layers= OR mcdnnic_topology=, not both")
+            self.layers_config = parse_mcdnnic_topology(
+                kwargs["mcdnnic_topology"],
+                kwargs.get("mcdnnic_parameters"))
+        else:
+            self.layers_config = list(kwargs.get("layers", ()))
         self.loss_function = kwargs.get("loss_function", "softmax")
         self.fused = kwargs.get("fused", True)
         self.mesh = kwargs.get("mesh")           # jax.sharding.Mesh → SPMD
