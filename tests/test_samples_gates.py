@@ -1,15 +1,82 @@
-"""BASELINE gate-model samples: MnistAE (RMSE gate) and Kohonen SOM.
+"""BASELINE gate-model samples: MnistAE (RMSE gate), Kohonen SOM, and
+the REAL-data MNIST accuracy gate.
 
-Reference gates (BASELINE.md): MnistAE validation RMSE <= 0.5478
-(/root/reference/docs/source/manualrst_veles_algorithms.rst:69); Kohonen
-demo from BASELINE.json config #5 (the reference publishes no numeric
-gate for it — the assertion is that the map organizes, i.e. the mean
-quantization error drops steeply).
+Reference gates (BASELINE.md): MNIST <= 1.48 % validation error, MnistAE
+validation RMSE <= 0.5478
+(/root/reference/docs/source/manualrst_veles_algorithms.rst:25-31,69);
+Kohonen demo from BASELINE.json config #5 (the reference publishes no
+numeric gate for it — the assertion is that the map organizes, i.e. the
+mean quantization error drops steeply).
 """
+
+import os
+
+import pytest
 
 from veles_tpu.backends import Device
 from veles_tpu.prng import RandomGenerator
 from veles_tpu.znicz.samples import kohonen, mnist_ae
+
+MNIST_MIRRORS = [
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "https://storage.googleapis.com/cvdf-datasets/mnist/",
+]
+MNIST_FILES = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+               "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+
+
+def _ensure_real_mnist():
+    """Real IDX files, via the Downloader unit when egress exists;
+    returns the reason string when unavailable."""
+    from veles_tpu.config import root
+    from veles_tpu.datasets import load_mnist
+    from veles_tpu.downloader import Downloader
+    from veles_tpu.workflow import Workflow
+    if load_mnist(n_train=1, n_valid=1)[2]:
+        return None
+    target = os.path.join(os.path.expanduser(
+        root.common.dirs.get("datasets", ".")), "mnist")
+    last = None
+    import socket
+    old_timeout = socket.getdefaulttimeout()
+    socket.setdefaulttimeout(20)
+    try:
+        for mirror in MNIST_MIRRORS:
+            try:
+                for name in MNIST_FILES:
+                    Downloader(Workflow(None),
+                               url=mirror + name + ".gz",
+                               directory=target,
+                               files=[name + ".gz"]).initialize()
+                return None
+            except Exception as exc:  # noqa: BLE001 - offline is expected
+                last = exc
+    finally:
+        socket.setdefaulttimeout(old_timeout)
+    return "real MNIST absent and download failed (zero-egress " \
+           "environment): %s: %s" % (type(last).__name__, last)
+
+
+def test_mnist_real_data_gate():
+    """The published 1.48 % MNIST gate, run on the REAL dataset (VERDICT
+    round-2 item 5).  Skipped with an explicit reason when the IDX files
+    are absent and cannot be fetched (this build env has zero egress)."""
+    reason = _ensure_real_mnist()
+    if reason:
+        pytest.skip(reason)
+    from veles_tpu import prng
+    from veles_tpu.znicz.samples import mnist
+    prng.get().seed(42)
+    wf = mnist.create_workflow(
+        loader={"minibatch_size": 60,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 60, "fail_iterations": 25,
+                  "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    assert wf.loader.is_real, "real IDX files expected at this point"
+    wf.run()
+    res = wf.gather_results()
+    assert res["best_validation_error_pt"] <= 1.48, res
 
 
 def test_mnist_ae_rmse_gate():
