@@ -3,8 +3,12 @@
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 
 Primary metric (BASELINE.json): **ImageNet AlexNet images/sec/chip** —
-synthetic ImageNet-shaped data resident in HBM, fused train step (forward +
-loss + backward + update as one donated jit), batch 128, f32.
+synthetic ImageNet-shaped data resident in HBM, batch 128, f32, measured on
+the **epoch-scan path** (``znicz/scan_step.py``): every dispatch carries
+``steps_per_dispatch`` fused train steps inside one ``lax.scan``, so the
+number reflects chip compute, not the ~14 ms per-launch RTT of the
+tunneled (axon) transport.  The per-launch path is reported alongside as
+``alexnet_step_images_per_sec`` so dispatch overhead stays visible.
 
 ``vs_baseline`` compares against the reference's CUDA backend era:
 published Caffe/cuDNN-v1 AlexNet training throughput on the GTX TITAN /
@@ -13,16 +17,27 @@ a GTX TITAN autotune entry) was ~230-260 images/sec; we use a generous
 500 img/s anchor so vs_baseline understates rather than overstates the win.
 
 Also reported in the same JSON line:
-- ``model_tflops_per_sec`` + ``mfu_vs_bf16_peak`` — achieved model FLOP/s
-  from XLA's own cost analysis of the compiled step, against the v5e
-  197-TFLOP/s bf16 peak, so perf is judged against the chip;
+- ``f32_model_tflops_per_sec`` / ``bf16_model_tflops_per_sec`` +
+  ``*_mfu_vs_bf16_peak`` — achieved model FLOP/s against the v5e
+  197-TFLOP/s bf16 peak.  FLOPs per step come from XLA's own
+  ``cost_analysis()`` of the compiled per-minibatch step when available;
+  when that fails the failure is LOGGED to stderr and an analytic count
+  (conv/fc matmul FLOPs x3 for fwd+bwd, the standard MFU convention) is
+  used instead — the bench never silently drops its key diagnostic.
+- ``bf16_speedup_vs_f32`` — the mixed-precision gain on the scan path.
+- ``pallas_lrn_speedup`` — epoch-scan throughput with the Pallas LRN
+  kernel pair enabled vs the jnp formula (records the hand-kernel delta
+  on the real chip once per round).
 - ``mnist_anchor_images_per_sec`` + ``mnist_vs_anchor`` — the round-1
   MNIST-FC epoch-scan anchor (1.45M img/s recorded on one v5e chip),
   kept as a regression canary for the dispatch/scan path.
+- ``spread`` — {name: [min_s, median_s, n]} per timed region, so
+  contention claims are checkable from the JSON alone.
 """
 
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -33,8 +48,24 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 ALEXNET_BASELINE = 500.0
 # images/sec recorded for the MNIST-FC scan bench on one v5e chip, round 1
 MNIST_ANCHOR = 1_450_000.0
-# TPU v5e peak: 197 TFLOP/s bf16 (f32 matmuls run at ~1/4 of that)
+# TPU v5e peak: 197 TFLOP/s bf16 (f32 matmuls run at a fraction of that)
 V5E_BF16_PEAK = 197e12
+
+SPREAD = {}
+_T0 = time.perf_counter()
+
+
+def _stamp(msg):
+    """Stage progress to stderr: compiles on a contended tunneled chip
+    can take many minutes each — a silent bench is undebuggable."""
+    print("bench [%7.1fs] %s" % (time.perf_counter() - _T0, msg),
+          file=sys.stderr, flush=True)
+
+
+def _record(name, times):
+    SPREAD[name] = [round(min(times), 4),
+                    round(statistics.median(times), 4), len(times)]
+    return min(times)
 
 
 def _sync(step):
@@ -46,26 +77,100 @@ def _sync(step):
         jax.tree_util.tree_leaves(step._params_)[0]).ravel()[0])
 
 
-def bench_alexnet(batch=128, steps=16, repeats=5, compute_dtype=None):
-    """AlexNet fused-train-step throughput, one real chip.
+def analytic_train_flops_per_image(wf):
+    """Matmul-model FLOPs per image for one train step: forward conv/fc
+    dot FLOPs x3 (activation-grad + weight-grad matmuls), the standard
+    MFU accounting (elementwise/pooling/LRN excluded)."""
+    from veles_tpu.znicz.conv import Conv
+    from veles_tpu.znicz.all2all import All2All
+    fwd_flops = 0.0
+    for fwd in wf.forwards:
+        if isinstance(fwd, Conv):
+            ky, kx, c_in, n_k = fwd.weights.shape
+            _, oh, ow, _ = fwd.output.shape
+            fwd_flops += 2.0 * oh * ow * ky * kx * c_in * n_k
+        elif isinstance(fwd, All2All):
+            n_in, n_out = fwd.weights.shape
+            fwd_flops += 2.0 * n_in * n_out
+    return 3.0 * fwd_flops
 
-    The minibatch gather rides inside the jitted step (one executable
-    launch per step); n_train=8*batch keeps the per-epoch metric flush
-    (one small D2H sync — the Decision protocol's class-end read)
-    amortized the way a real epoch would.  ``compute_dtype="bfloat16"``
-    measures the mixed-precision step (f32 master weights/loss)."""
+
+def _xla_flops_per_step(step, wf, batch):
+    """FLOPs per fused train step from XLA's cost model; analytic
+    fallback (never silent — the reason is printed to stderr)."""
+    try:
+        cost = step._train_step_g_.lower(
+            step._data_dev_, step._y_dev_, step._params_, step._opt_,
+            step._macc_, wf.loader._padded_indices_, batch,
+            7, 1.0).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        if flops > 0:
+            return flops, "xla_cost_analysis"
+        print("bench: cost_analysis returned no flops key; "
+              "falling back to analytic count", file=sys.stderr)
+    except Exception as exc:  # noqa: BLE001 - diagnostic path must not die
+        print("bench: cost_analysis failed (%s: %s); falling back to "
+              "analytic count" % (type(exc).__name__, exc), file=sys.stderr)
+    return analytic_train_flops_per_image(wf) * batch, "analytic"
+
+
+def _make_alexnet(batch, compute_dtype=None, epoch_scan=False,
+                  use_pallas_lrn=False):
     from veles_tpu.backends import Device
+    from veles_tpu.config import root
     from veles_tpu.prng import RandomGenerator
     from veles_tpu.znicz.samples import alexnet
-    from veles_tpu import loader as loader_mod
 
-    trainer = {"compute_dtype": compute_dtype} if compute_dtype else {}
-    wf = alexnet.create_workflow(
-        loader={"minibatch_size": batch, "n_train": 8 * batch,
-                "n_valid": batch, "prng": RandomGenerator().seed(3)},
-        decision={"max_epochs": 10 ** 9, "silent": True},
-        trainer=trainer)
-    wf.initialize(device=Device(backend="auto"))
+    prior = root.common.engine.get("use_pallas", False)
+    root.common.engine.use_pallas = bool(use_pallas_lrn)
+    try:
+        trainer = {"compute_dtype": compute_dtype} if compute_dtype else {}
+        wf = alexnet.create_workflow(
+            loader={"minibatch_size": batch, "n_train": 8 * batch,
+                    "n_valid": batch, "prng": RandomGenerator().seed(3)},
+            decision={"max_epochs": 10 ** 9, "silent": True},
+            trainer=trainer, epoch_scan=epoch_scan)
+        wf.initialize(device=Device(backend="auto"))
+    finally:
+        root.common.engine.use_pallas = prior
+    return wf
+
+
+def bench_alexnet_scan(batch=128, epochs_per_dispatch=4, repeats=5,
+                       compute_dtype=None, use_pallas_lrn=False,
+                       name="alexnet_f32"):
+    """AlexNet epoch-scan throughput: ``8 * epochs_per_dispatch`` fused
+    train steps ride ONE ``lax.scan`` dispatch (n_train = 8*batch), so
+    per-launch RTT is amortized ~32x and the timing is chip-bound."""
+    _stamp("building %s (epoch-scan)" % name)
+    wf = _make_alexnet(batch, compute_dtype=compute_dtype, epoch_scan=True,
+                       use_pallas_lrn=use_pallas_lrn)
+    step = wf.fused_step
+    _stamp("%s: compiling + warmup" % name)
+    step.train_epochs(epochs_per_dispatch)  # compile
+    step.train_epochs(epochs_per_dispatch)
+    _sync(step)
+    times = []
+    images = 8 * batch * epochs_per_dispatch
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        step.train_epochs(epochs_per_dispatch)
+        _sync(step)
+        times.append(time.perf_counter() - t0)
+    _stamp("%s: measured" % name)
+    # return only the rate: holding wf alive would keep its HBM-resident
+    # synthetic dataset allocated through the subsequent benches
+    return images / _record(name, times)
+
+
+def bench_alexnet_step(batch=128, steps=16, repeats=5):
+    """AlexNet per-launch-path throughput (dispatch-overhead diagnostic)
+    plus the FLOPs-per-step probe for MFU accounting."""
+    from veles_tpu import loader as loader_mod
+    _stamp("building alexnet_step (per-launch)")
+    wf = _make_alexnet(batch)
     step = wf.fused_step
 
     def next_train_step():
@@ -78,40 +183,26 @@ def bench_alexnet(batch=128, steps=16, repeats=5, compute_dtype=None):
     next_train_step()  # compile
     next_train_step()
     _sync(step)
-    best = None
+    times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(steps):
             next_train_step()
         _sync(step)
-        dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
-    dt = best
-    imgs_per_sec = batch * steps / dt
-
-    # achieved model FLOP/s straight from XLA's cost model of the step
-    flops_per_step = None
-    try:
-        cost = step._train_step_g_.lower(
-            step._data_dev_, step._y_dev_, step._params_, step._opt_,
-            step._macc_, wf.loader._padded_indices_, batch,
-            7).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        flops_per_step = float(cost.get("flops", 0.0)) or None
-    except Exception:
-        pass
-    tflops = (flops_per_step * steps / dt / 1e12) if flops_per_step else None
-    return imgs_per_sec, tflops
+        times.append(time.perf_counter() - t0)
+    ips = batch * steps / _record("alexnet_step", times)
+    flops_per_step, flops_source = _xla_flops_per_step(step, wf, batch)
+    _stamp("alexnet_step: measured (flops via %s)" % flops_source)
+    return ips, flops_per_step, flops_source
 
 
-def bench_mnist(batch=512, epochs=12, n_train=16384):
+def bench_mnist(batch=512, epochs=12, n_train=16384, repeats=10):
     """MNIST-FC bulk epoch-scan throughput (dispatch-path canary)."""
-    import jax
     from veles_tpu.backends import Device
     from veles_tpu.prng import RandomGenerator
     from veles_tpu.znicz.samples import mnist
 
+    _stamp("building mnist canary")
     wf = mnist.create_workflow(
         loader={"minibatch_size": batch, "n_train": n_train,
                 "n_valid": batch, "prng": RandomGenerator().seed(3)},
@@ -123,36 +214,92 @@ def bench_mnist(batch=512, epochs=12, n_train=16384):
     # recompile inside the timed region
     step.train_epochs(epochs)
     _sync(step)
-    best = None
-    for _ in range(10):  # min-of-10 SHORT blocks: the shared tunneled
+    times = []
+    for _ in range(repeats):  # many SHORT blocks: the shared tunneled
         # chip has multi-second contention bursts; more, smaller samples
         # give the min a chance to land in a quiet window
         t0 = time.perf_counter()
         step.train_epochs(epochs)
         _sync(step)
-        dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
-    return n_train * epochs / best
+        times.append(time.perf_counter() - t0)
+    return n_train * epochs / _record("mnist", times)
+
+
+def _pallas_lrn_subprocess(timeout=600):
+    """The Pallas-LRN stage in a KILLABLE subprocess: Mosaic compiles
+    through the tunneled (axon) remote-compile service can exceed 20
+    minutes or wedge outright — measured once per round, but never
+    allowed to take the whole bench down (VERDICT r2 item 10)."""
+    import subprocess
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--stage", "pallas_lrn"],
+            capture_output=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None, "timeout after %ds (Mosaic remote compile)" % timeout
+    if proc.returncode:
+        return None, "exit %d: %s" % (proc.returncode,
+                                      proc.stderr.decode()[-500:])
+    try:
+        line = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+        return float(line["pallas_lrn_images_per_sec"]), None
+    except (ValueError, KeyError, IndexError) as exc:
+        return None, "bad stage output: %r" % exc
 
 
 if __name__ == "__main__":
-    alexnet_ips, tflops = bench_alexnet()
-    bf16_ips, _ = bench_alexnet(compute_dtype="bfloat16")
+    BATCH = 128  # shared by every AlexNet bench below and the MFU math
+    if "--stage" in sys.argv:  # subprocess entry: one isolated stage
+        stage = sys.argv[sys.argv.index("--stage") + 1]
+        assert stage == "pallas_lrn", stage
+        ips = bench_alexnet_scan(batch=BATCH, use_pallas_lrn=True,
+                                 repeats=3, name="alexnet_pallas_lrn")
+        print(json.dumps({"pallas_lrn_images_per_sec": round(ips, 1),
+                          "spread": SPREAD}))
+        sys.exit(0)
+    # pallas-LRN subprocess FIRST: on a directly-attached TPU, libtpu is
+    # single-process, so the child must own the chip before this process
+    # initializes JAX (every bench call below does)
+    _stamp("pallas-LRN stage (isolated subprocess)")
+    lrn_ips, lrn_error = _pallas_lrn_subprocess()
+    if lrn_error:
+        print("bench: pallas-LRN run failed: %s" % lrn_error,
+              file=sys.stderr)
+    scan_ips = bench_alexnet_scan(batch=BATCH)
+    bf16_ips = bench_alexnet_scan(batch=BATCH, compute_dtype="bfloat16",
+                                  name="alexnet_bf16")
+    step_ips, flops_per_step, flops_source = bench_alexnet_step(
+        batch=BATCH)
+    flops_per_image = flops_per_step / BATCH
     mnist_ips = bench_mnist()
-    # headline stays f32 (metric continuity vs the f32 CUDA-era anchor);
-    # the bf16 mixed-precision number rides alongside
     line = {
         "metric": "alexnet_train_images_per_sec_per_chip",
-        "value": round(alexnet_ips, 1),
+        "value": round(scan_ips, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(alexnet_ips / ALEXNET_BASELINE, 3),
+        "vs_baseline": round(scan_ips / ALEXNET_BASELINE, 3),
         "alexnet_bf16_images_per_sec": round(bf16_ips, 1),
         "bf16_vs_baseline": round(bf16_ips / ALEXNET_BASELINE, 3),
+        "bf16_speedup_vs_f32": round(bf16_ips / scan_ips, 3),
+        "alexnet_step_images_per_sec": round(step_ips, 1),
+        "flops_per_image": round(flops_per_image / 1e9, 3),
+        "flops_source": flops_source,
+        "f32_model_tflops_per_sec": round(
+            flops_per_image * scan_ips / 1e12, 2),
+        "f32_mfu_vs_bf16_peak": round(
+            flops_per_image * scan_ips / V5E_BF16_PEAK, 4),
+        "bf16_model_tflops_per_sec": round(
+            flops_per_image * bf16_ips / 1e12, 2),
+        "bf16_mfu_vs_bf16_peak": round(
+            flops_per_image * bf16_ips / V5E_BF16_PEAK, 4),
         "mnist_anchor_images_per_sec": round(mnist_ips, 1),
         "mnist_vs_anchor": round(mnist_ips / MNIST_ANCHOR, 3),
+        "spread": SPREAD,
     }
-    if tflops:
-        line["f32_model_tflops_per_sec"] = round(tflops, 2)
-        line["f32_mfu_vs_bf16_peak"] = round(
-            tflops * 1e12 / V5E_BF16_PEAK, 4)
+    if lrn_ips is not None:
+        line["pallas_lrn_images_per_sec"] = round(lrn_ips, 1)
+        line["pallas_lrn_speedup"] = round(lrn_ips / scan_ips, 3)
+    else:
+        line["pallas_lrn_error"] = lrn_error
     print(json.dumps(line))
