@@ -36,13 +36,13 @@ class ForgeStore:
 
     @staticmethod
     def _safe(s):
-        """Sanitize path components (uploads AND lookups must agree, and
-        traversal like ../../ must never leave the registry root)."""
+        """Validate path components STRICTLY: a name that would change
+        under sanitization (traversal, separators, leading dots) is
+        rejected outright — silently rewriting '../evil' to 'evil'
+        would store a manifest whose name disagrees with its directory
+        and alias distinct client names onto one entry."""
         out = "".join(c for c in s if c.isalnum() or c in "._-")
-        out = out.lstrip(".")
-        if not out:
-            # '..', '.', '///' etc. must not silently collapse into a
-            # shorter join that escapes or aliases registry levels
+        if not out or out != s or out.startswith("."):
             raise KeyError("invalid name/version: %r" % s)
         return out
 
