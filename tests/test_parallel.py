@@ -18,7 +18,8 @@ from veles_tpu.znicz.standard_workflow import StandardWorkflow
 from test_standard_workflow import BlobLoader, LAYERS
 
 
-def build(mesh=None, model_axis=None, max_epochs=3, minibatch=40, seed=21):
+def build(mesh=None, model_axis=None, max_epochs=3, minibatch=40, seed=21,
+          **extra):
     import veles_tpu.prng.random_generator as rg
     rg._generators.clear()
     rg.get(0).seed(seed)
@@ -29,7 +30,7 @@ def build(mesh=None, model_axis=None, max_epochs=3, minibatch=40, seed=21):
                 "prng": RandomGenerator().seed(5)},
         layers=LAYERS, loss_function="softmax",
         decision={"max_epochs": max_epochs, "silent": True},
-        fused=True, mesh=mesh, model_axis=model_axis)
+        fused=True, mesh=mesh, model_axis=model_axis, **extra)
     wf.initialize(device=Device(backend="cpu"))
     return wf
 
@@ -165,6 +166,48 @@ def test_tp_conv_equals_dp():
                               atol=2e-5), type(fd).__name__
     assert wf_d.decision.best_n_err_pt == pytest.approx(
         wf_t.decision.best_n_err_pt, abs=1e-9)
+
+
+def test_megatron_tp_equals_dp():
+    """Megatron col/row alternation is a layout change only: training
+    must match pure DP exactly (within f32 reduction noise)."""
+    wf_d = build(mesh=make_mesh({"data": 8}))
+    wf_m = build(mesh=make_mesh({"data": 4, "model": 2}),
+                 model_axis="model", tp_mode="megatron")
+    wf_d.run()
+    wf_m.run()
+    for fd, fm in zip(wf_d.forwards, wf_m.forwards):
+        assert numpy.allclose(fd.weights.map_read(), fm.weights.map_read(),
+                              atol=2e-5), type(fd).__name__
+    assert wf_d.decision.best_n_err_pt == pytest.approx(
+        wf_m.decision.best_n_err_pt, abs=1e-9)
+
+
+def test_megatron_sharding_alternates():
+    """Consecutive divisible FC weights pair column then row; the row
+    layer's bias replicates (it adds to the reduced activation)."""
+    mesh = make_mesh({"data": 4, "model": 2})
+    params = [{"weights": numpy.zeros((8, 6)), "bias": numpy.zeros(6)},
+              {"weights": numpy.zeros((6, 4)), "bias": numpy.zeros(4)},
+              {"weights": numpy.zeros((4, 4)), "bias": numpy.zeros(4)}]
+    shard = tensor_parallel_sharding(mesh, params, "model",
+                                     mode="megatron")
+    assert tuple(shard[0]["weights"].spec) == (None, "model")   # col
+    assert tuple(shard[0]["bias"].spec) == ("model",)
+    assert tuple(shard[1]["weights"].spec) == ("model", None)   # row
+    assert tuple(shard[1]["bias"].spec) == ()                   # psum'd
+    assert tuple(shard[2]["weights"].spec) == (None, "model")   # col again
+    # a non-FC layer breaks the pairing: the FC after it is column-split
+    params_mix = [
+        {"weights": numpy.zeros((8, 6)), "bias": numpy.zeros(6)},
+        {"weights": numpy.zeros((3, 3, 6, 6)), "bias": numpy.zeros(6)},
+        {"weights": numpy.zeros((6, 4)), "bias": numpy.zeros(4)}]
+    shard = tensor_parallel_sharding(mesh, params_mix, "model",
+                                     mode="megatron")
+    assert tuple(shard[2]["weights"].spec) == (None, "model")
+    with pytest.raises(ValueError, match="tp mode"):
+        tensor_parallel_sharding(mesh, {"weights": numpy.zeros((4, 4))},
+                                 "model", mode="megatorn")
 
 
 def test_conv_kernel_sharding_spec():

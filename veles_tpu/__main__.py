@@ -113,6 +113,11 @@ def make_parser():
                    help="SPMD device mesh axes")
     p.add_argument("--model-axis", default=None,
                    help="mesh axis for tensor parallelism")
+    p.add_argument("--tp-mode", default=None,
+                   choices=("column", "megatron"),
+                   help="tensor-parallel layout: column-split every "
+                        "layer, or megatron col/row alternation (one "
+                        "psum per FC pair instead of a gather per layer)")
     p.add_argument("--set", action="append", default=[], dest="sets",
                    metavar="attr.path=value",
                    help="set a workflow attribute after build/restore "
@@ -180,12 +185,13 @@ class Main:
         (workflow, was_restored)."""
         args = self.args
         if args.snapshot:
-            if args.mesh or args.model_axis or args.mode:
+            if args.mesh or args.model_axis or args.mode or args.tp_mode:
                 raise SystemExit(
-                    "--mesh/--model-axis/--mode cannot be applied to a "
-                    "restored snapshot (the pickled workflow keeps its "
-                    "build-time execution strategy); rebuild without "
-                    "--snapshot, or restore and resume as-is")
+                    "--mesh/--model-axis/--tp-mode/--mode cannot be "
+                    "applied to a restored snapshot (the pickled "
+                    "workflow keeps its build-time execution strategy); "
+                    "rebuild without --snapshot, or restore and resume "
+                    "as-is")
             from .snapshotter import restore
             self.workflow = restore(args.snapshot)
             self.snapshot_loaded = True
@@ -201,6 +207,8 @@ class Main:
                 kwargs.setdefault("mesh", make_mesh(args.mesh))
                 if args.model_axis:
                     kwargs.setdefault("model_axis", args.model_axis)
+                if args.tp_mode:
+                    kwargs.setdefault("tp_mode", args.tp_mode)
             self.workflow = factory(**kwargs)
         for assignment in args.sets:
             path, _, value = assignment.partition("=")
@@ -330,6 +338,8 @@ class Main:
                                         for kv in args.mesh.items())]
         if args.model_axis:
             argv += ["--model-axis", args.model_axis]
+        if args.tp_mode:
+            argv += ["--tp-mode", args.tp_mode]
         if args.snapshot:
             argv += ["--snapshot", args.snapshot]
         for assignment in args.sets:

@@ -25,11 +25,12 @@ class DistributedTrainStep(FusedTrainStep):
 
     def __init__(self, workflow, forwards, gd_units, mesh,
                  loss="softmax", data_axis="data", model_axis=None,
-                 **kwargs):
+                 tp_mode="column", **kwargs):
         super().__init__(workflow, forwards, gd_units, loss=loss, **kwargs)
         self.mesh = mesh
         self.data_axis = data_axis
         self.model_axis = model_axis
+        self.tp_mode = tp_mode
 
     def initialize(self, device=None, **kwargs):
         super().initialize(device=device, **kwargs)
@@ -49,7 +50,7 @@ class DistributedTrainStep(FusedTrainStep):
             self._macc_ = jax.tree.map(numpy.asarray, self._macc_)
         if self.model_axis and self.model_axis in m.shape:
             param_shard = mesh_mod.tensor_parallel_sharding(
-                m, self._params_, self.model_axis)
+                m, self._params_, self.model_axis, mode=self.tp_mode)
         else:
             param_shard = mesh_mod.data_parallel_sharding(m, self._params_)
         # opt state shards like its param (momentum buffers are

@@ -53,25 +53,77 @@ def data_parallel_sharding(mesh, params_tree):
     return jax.tree.map(lambda _: rep, params_tree)
 
 
-def tensor_parallel_sharding(mesh, params_tree, model_axis="model"):
-    """Column-split tensor parallelism: weights split their *output*
-    dim on ``model`` — 2-D FC weights on dim 1, 4-D conv kernels
-    (ky, kx, c_in, n_kernels) on the kernel dim 3 (so each model-shard
-    computes a slice of the output channels; XLA partitions the conv and
-    gathers activations before the next layer — one collective per
-    layer), 1-D biases on dim 0.  Everything indivisible replicates.
-    (A Megatron alternating column/row scheme would halve the
-    collectives; tracked as a future optimization.)"""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+def tensor_parallel_sharding(mesh, params_tree, model_axis="model",
+                             mode="column"):
+    """Tensor parallelism over ``model``.
 
-    def spec(p):
-        ndim = getattr(p, "ndim", 0)
-        if ndim == 2 and p.shape[1] % mesh.shape[model_axis] == 0:
-            return NamedSharding(mesh, P(None, model_axis))
-        if ndim == 4 and p.shape[3] % mesh.shape[model_axis] == 0:
-            return NamedSharding(mesh, P(None, None, None, model_axis))
-        if ndim == 1 and p.shape[0] % mesh.shape[model_axis] == 0:
-            return NamedSharding(mesh, P(model_axis))
-        return NamedSharding(mesh, P())
+    ``mode="column"`` (default): every weight splits its *output* dim —
+    2-D FC weights on dim 1, 4-D conv kernels (ky, kx, c_in, n_kernels)
+    on the kernel dim 3 (each model-shard computes a slice of the output
+    channels; XLA partitions the conv and gathers activations before the
+    next layer — one collective per layer), 1-D biases on dim 0.
+
+    ``mode="megatron"``: consecutive divisible 2-D FC weights ALTERNATE
+    column (None, model) then row (model, None) splits — the Megatron
+    MLP pairing.  A column layer's output stays feature-sharded, the
+    following row layer consumes it shard-local, and only ONE psum (the
+    row matmul's reduction) fires per pair instead of a gather per
+    layer.  Row-split layers replicate their bias (it adds to a reduced,
+    replicated activation); conv kernels keep the output-channel split.
+
+    Everything indivisible replicates.  ``params_tree`` is the per-layer
+    list of param dicts the fused trainers carry; megatron mode walks it
+    in layer order to assign the alternation."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
     import jax
-    return jax.tree.map(spec, params_tree)
+
+    size = mesh.shape[model_axis]
+    col2 = NamedSharding(mesh, P(None, model_axis))
+    row2 = NamedSharding(mesh, P(model_axis, None))
+    col1 = NamedSharding(mesh, P(model_axis))
+    rep = NamedSharding(mesh, P())
+
+    def base_spec(p):
+        ndim = getattr(p, "ndim", 0)
+        if ndim == 2 and p.shape[1] % size == 0:
+            return col2
+        if ndim == 4 and p.shape[3] % size == 0:
+            return NamedSharding(mesh, P(None, None, None, model_axis))
+        if ndim == 1 and p.shape[0] % size == 0:
+            return col1
+        return rep
+
+    if mode not in ("column", "megatron"):
+        raise ValueError("tp mode must be 'column' or 'megatron', got %r"
+                         % (mode,))
+    if mode == "column" or not isinstance(params_tree, (list, tuple)):
+        return jax.tree.map(base_spec, params_tree)
+    out = []
+    want_row = False  # first eligible FC layer is column-split
+    for layer in params_tree:
+        if not isinstance(layer, dict):
+            out.append(jax.tree.map(base_spec, layer))
+            continue
+        w = layer.get("weights")
+        if getattr(w, "ndim", 0) != 2:
+            # a non-FC layer (conv, paramless) breaks the pairing: its
+            # output is not contracted-dim-sharded, so row-splitting the
+            # next FC would only add resharding traffic
+            want_row = False
+        specs = {}
+        if getattr(w, "ndim", 0) == 2 and want_row \
+                and w.shape[0] % size == 0:
+            specs["weights"] = row2
+            # the row matmul's output is already reduced/replicated:
+            # its bias must replicate too
+            for name, p in layer.items():
+                if name != "weights":
+                    specs[name] = rep
+            want_row = False
+        else:
+            for name, p in layer.items():
+                specs[name] = base_spec(p)
+            if getattr(w, "ndim", 0) == 2 and w.shape[1] % size == 0:
+                want_row = True  # next divisible FC pairs as the row
+        out.append(specs)
+    return out

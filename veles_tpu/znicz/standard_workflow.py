@@ -126,6 +126,7 @@ class StandardWorkflow(Workflow):
         self.fused = kwargs.get("fused", True)
         self.mesh = kwargs.get("mesh")           # jax.sharding.Mesh → SPMD
         self.model_axis = kwargs.get("model_axis")
+        self.tp_mode = kwargs.get("tp_mode", "column")
         # epoch_scan: one lax.scan dispatch per class instead of one
         # dispatch per minibatch (FullBatch loaders only)
         self.epoch_scan = kwargs.get("epoch_scan", False)
@@ -261,7 +262,7 @@ class StandardWorkflow(Workflow):
             self.fused_step = DistributedTrainStep(
                 self, self.forwards, self.gds, mesh=self.mesh,
                 loss=self.loss_function, model_axis=self.model_axis,
-                **self.trainer_config)
+                tp_mode=self.tp_mode, **self.trainer_config)
             self.fused_step.link_from(self.loader)
             self.fused_step.link_loader(self.loader)
         elif self.epoch_scan:
