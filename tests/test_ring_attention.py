@@ -1,0 +1,72 @@
+"""Ring attention (sequence parallelism) parity on the virtual 8-CPU
+mesh: the ppermute ring + flash recurrence must match single-device
+softmax attention bit-for-tolerance, causal and not, and compose with
+the data axis."""
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.parallel.mesh import make_mesh
+from veles_tpu.parallel.ring import attention_reference, ring_attention
+
+
+def _qkv(b=2, t=32, h=2, d=8, seed=0):
+    rng = numpy.random.RandomState(seed)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((b, t, h, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_reference(causal):
+    q, k, v = _qkv()
+    mesh = make_mesh({"seq": 8})
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    ref = attention_reference(q, k, v, causal=causal)
+    assert out.shape == ref.shape
+    assert numpy.allclose(numpy.asarray(out), numpy.asarray(ref),
+                          atol=2e-5), numpy.abs(
+        numpy.asarray(out) - numpy.asarray(ref)).max()
+
+
+def test_ring_composes_with_data_axis():
+    q, k, v = _qkv(b=4, t=16)
+    mesh = make_mesh({"data": 2, "seq": 4})
+    out = ring_attention(q, k, v, mesh, data_axis="data", causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    assert numpy.allclose(numpy.asarray(out), numpy.asarray(ref),
+                          atol=2e-5)
+
+
+def test_ring_under_jit_and_grad():
+    """The ring is jittable and differentiable (training path)."""
+    q, k, v = _qkv(t=16)
+    mesh = make_mesh({"seq": 8})
+
+    @jax.jit
+    def loss(q, k, v):
+        return (ring_attention(q, k, v, mesh) ** 2).sum()
+
+    @jax.jit
+    def loss_ref(q, k, v):
+        return (attention_reference(q, k, v) ** 2).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        assert numpy.allclose(numpy.asarray(a), numpy.asarray(b),
+                              atol=5e-4)
+
+
+def test_ring_long_sequence_never_materializes_full_scores():
+    """Smoke at a length where the full [T,T] score matrix per head
+    would dominate memory: still runs shard-local."""
+    q, k, v = _qkv(b=1, t=1024, h=1, d=8, seed=3)
+    mesh = make_mesh({"seq": 8})
+    out = ring_attention(q, k, v, mesh, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    assert numpy.allclose(numpy.asarray(out), numpy.asarray(ref),
+                          atol=5e-5)
