@@ -76,6 +76,34 @@ def apply_config_file(path):
     exec(compile(source, path, "exec"), {"root": root, "Range": Range})
 
 
+def parse_seed(spec):
+    """--random-seed value → int: decimal, ``0x``/bare hex, or
+    ``file:N`` (N bytes read from the file, e.g. ``/dev/urandom:16``) —
+    the reference's seeding spec surface (__main__.py:483-539)."""
+    spec = str(spec)
+    if ":" in spec and not spec.lower().startswith("0x"):
+        fname, _, count = spec.rpartition(":")
+        try:
+            n = int(count)
+            with open(fname, "rb") as f:
+                data = f.read(n)
+        except (ValueError, OSError) as e:
+            raise SystemExit("bad --random-seed %r (%s)" % (spec, e))
+        if len(data) < n:
+            raise SystemExit("--random-seed %r: %s has only %d bytes"
+                             % (spec, fname, len(data)))
+        return int.from_bytes(data, "little") % (1 << 63)
+    try:
+        return int(spec, 0)     # decimal or 0x-prefixed hex
+    except ValueError:
+        try:
+            return int(spec, 16)  # bare hex digest (reference unhexlify)
+        except ValueError:
+            raise SystemExit(
+                "bad --random-seed %r (want an int, hex, or file:N)"
+                % spec)
+
+
 def parse_mesh(text):
     """``data=8,model=2`` → {"data": 8, "model": 2}."""
     axes = {}
@@ -156,6 +184,10 @@ def make_parser():
     p.add_argument("--ensemble-test", default=None, metavar="FILE.json",
                    help="averaged-probability inference over the "
                         "ensemble train output JSON")
+    p.add_argument("--frontend", action="store_true",
+                   help="interactive wizard: answer prompts, get the "
+                        "generated command line, run it (reference "
+                        "--frontend web wizard, terminal edition)")
     p.add_argument("--listen", default=None, metavar="HOST:PORT",
                    help="farm --optimize/--ensemble-train trials through "
                         "a TCP job master bound here; start workers on "
@@ -228,8 +260,8 @@ class Main:
                 from .prng import RandomGenerator
                 # own seeded stream: drills replay under --random-seed
                 # without consuming the loaders' stream
-                seed = int(args.random_seed if args.random_seed
-                           is not None else 1234) + 313
+                seed = (parse_seed(args.random_seed)
+                        if args.random_seed is not None else 1234) + 313
                 reaper = Reaper(wf, prng=RandomGenerator().seed(seed))
                 reaper.link_from(wf.decision)
                 reaper.link_loader(wf.loader)
@@ -256,6 +288,8 @@ class Main:
     # -- entry ---------------------------------------------------------------
     def run(self):
         args = self.args
+        if args.frontend:
+            return self._run_frontend()
         if args.config is not None and "=" in args.config \
                 and not os.path.exists(args.config):
             # `workflow.py root.x=1` without a config file
@@ -277,6 +311,14 @@ class Main:
         # config file, then the CLI overrides, are applied on top of them
         # (reference order: _load_model :401 before _apply_config :432)
         module = import_workflow_module(args.workflow)
+        # machine-local site_config lands AFTER the module's defaults
+        # (so a site file can actually override them) and BEFORE the
+        # config file / CLI overrides (which stay the most specific).
+        # The reference applied site files at config-import time, which
+        # let module defaults clobber them (config.py:294-308) — this
+        # order is the deliberate improvement.
+        from .config import apply_site_config
+        apply_site_config()
         if args.config:
             apply_config_file(args.config)
         for override in args.overrides:
@@ -304,7 +346,7 @@ class Main:
         if seed is None:
             seed = root.common.get("random_seed", 1234)
         from . import prng
-        prng.get(0).seed(int(seed))
+        prng.get(0).seed(parse_seed(seed))
         self.launcher = Launcher(backend=args.backend,
                                  result_file=args.result_file)
         if not hasattr(module, "run"):
@@ -317,6 +359,54 @@ class Main:
             return 1  # unit queue drained without reaching the end point
         return 0
 
+
+    def _run_frontend(self, input_fn=input, output=print):
+        """Terminal wizard: prompt for the run's pieces, print the
+        generated command line, execute it (the reference's --frontend
+        opened a web wizard that produced a command line the same way,
+        __main__.py:258-285)."""
+        def ask(prompt, default=""):
+            try:
+                answer = input_fn("%s%s: " % (
+                    prompt, " [%s]" % default if default else ""))
+            except EOFError:
+                return default
+            return answer.strip() or default
+
+        argv = []
+        workflow = ask("Workflow module/file", self.args.workflow or "")
+        if not workflow:
+            raise SystemExit("--frontend needs a workflow to run")
+        argv.append(workflow)
+        config = ask("Config file (blank = none)")
+        if config:
+            argv.append(config)
+        while True:
+            override = ask("Override root.x.y=value (blank = done)")
+            if not override:
+                break
+            if "=" not in override:
+                output("  ignored (need path=value): %s" % override)
+                continue
+            argv.append(override)
+        backend = ask("Backend (auto/tpu/cpu/numpy)", "auto")
+        if backend and backend != "auto":
+            argv += ["--backend", backend]
+        mode = ask("Execution mode (fused/scan/graph)", "fused")
+        if mode and mode != "fused":
+            argv += ["--mode", mode]
+        seed = ask("Random seed", "1234")
+        if seed:
+            argv += ["--random-seed", seed]
+        result_file = ask("Result JSON file (blank = none)")
+        if result_file:
+            argv += ["--result-file", result_file]
+        import shlex
+        output("Running with the following command line: "
+               "python -m veles_tpu %s" % shlex.join(argv))
+        if ask("Proceed? (y/n)", "y").lower() not in ("y", "yes"):
+            return 2
+        return Main(argv).run()
 
     # -- meta modes: GA optimization and ensembles ---------------------------
     def _trial_argv(self):

@@ -259,3 +259,51 @@ root.update({
         "random_seed": 1234,
     },
 })
+
+
+def apply_site_config(cfg=None, paths=None):
+    """Apply per-machine overrides: import ``site_config.py`` from each
+    existing path (default: $VELES_TPU_SITE_CONFIG, the XDG config dir,
+    the cwd) and call its ``update(root)``.
+
+    The reference loaded the same hook from its dist-config dir, the
+    user dir, and the cwd at import time
+    (/root/reference/veles/config.py:294-308); here it is an explicit
+    call (the CLI runs it before workflow-module import) so library
+    users and tests control when machine-local state enters the tree.
+    Returns the list of files applied."""
+    import importlib.util
+    cfg = cfg if cfg is not None else root
+    if paths is None:
+        paths = []
+        env = os.environ.get("VELES_TPU_SITE_CONFIG")
+        if env:
+            paths.append(env)
+        paths.append(os.path.join(
+            os.environ.get("XDG_CONFIG_HOME",
+                           os.path.expanduser("~/.config")),
+            "veles_tpu"))
+        paths.append(os.getcwd())
+    env_explicit = os.environ.get("VELES_TPU_SITE_CONFIG")
+    applied = []
+    for path in paths:
+        fname = path if path.endswith(".py") else os.path.join(
+            path, "site_config.py")
+        if not os.path.exists(fname):
+            if env_explicit and path == env_explicit:
+                # the optional search dirs skip silently, but a typo'd
+                # explicit pointer must not silently drop site overrides
+                raise FileNotFoundError(
+                    "VELES_TPU_SITE_CONFIG=%r does not exist" % path)
+            continue
+        spec = importlib.util.spec_from_file_location(
+            "veles_tpu_site_config_%d" % len(applied), fname)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        update = getattr(module, "update", None)
+        if update is None:
+            raise AttributeError(
+                "%s must define update(root)" % fname)
+        update(cfg)
+        applied.append(fname)
+    return applied
