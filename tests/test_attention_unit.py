@@ -1,0 +1,156 @@
+"""MultiHeadAttention unit: forward math, VJP backward, StandardWorkflow
+training, and the ring-attention (sequence-parallel) wiring."""
+
+import numpy
+
+import jax
+
+from veles_tpu.backends import Device
+from veles_tpu.memory import Array
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.workflow import Workflow
+from veles_tpu.znicz.attention import (GDMultiHeadAttention,
+                                       MultiHeadAttention)
+from veles_tpu.parallel.mesh import make_mesh
+from veles_tpu.parallel.ring import attention_reference
+
+
+def _unit(mesh=None, heads=2, causal=False, t=8, d=12, b=4, seed=7):
+    wf = Workflow(name="attn")
+    u = MultiHeadAttention(wf, heads=heads, causal=causal, mesh=mesh,
+                           prng=RandomGenerator().seed(seed))
+    rng = numpy.random.RandomState(1)
+    u.input = Array(rng.uniform(-1, 1, (b, t, d)).astype(numpy.float32))
+    return u
+
+
+def test_forward_matches_manual():
+    u = _unit()
+    u.initialize(device=Device(backend="cpu"))
+    u.run()
+    x = numpy.asarray(u.input.map_read())
+    w = numpy.asarray(u.weights.map_read())
+    p = numpy.asarray(u.proj.map_read())
+    bias = numpy.asarray(u.bias.map_read())
+    b, t, d = x.shape
+    qkv = x @ w
+    q, k, v = (qkv[..., i * d:(i + 1) * d].reshape(b, t, 2, d // 2)
+               for i in range(3))
+    expect = numpy.asarray(attention_reference(
+        q, k, v)).reshape(b, t, d) @ p + bias
+    assert numpy.allclose(u.output.map_read(), expect, atol=1e-5)
+
+
+def test_backward_is_exact_vjp():
+    u = _unit(causal=True)
+    u.initialize(device=Device(backend="cpu"))
+    u.run()
+    gd = GDMultiHeadAttention(u.workflow, learning_rate=0.0)
+    gd.link_forward(u)
+    rng = numpy.random.RandomState(2)
+    err = rng.uniform(-1, 1, u.output.shape).astype(numpy.float32)
+    params = {k: numpy.asarray(v) for k, v in u.params.items()}
+    x = numpy.asarray(u.input.map_read())
+    err_in, grads = gd.backward(params, x, None, err, n_valid=x.shape[0])
+    _, pull = jax.vjp(lambda p, xx: u.apply(p, xx), params, x)
+    g_ref, e_ref = pull(err)
+    assert numpy.allclose(numpy.asarray(err_in),
+                          numpy.asarray(e_ref), atol=1e-5)
+    for name in ("weights", "proj", "bias"):
+        assert numpy.allclose(
+            numpy.asarray(grads[name]),
+            numpy.asarray(g_ref[name]) / x.shape[0], atol=1e-5), name
+
+
+def test_ring_mesh_variant_matches_single_device():
+    u_ref = _unit(heads=2, causal=True, t=16)
+    u_ref.initialize(device=Device(backend="cpu"))
+    u_ref.run()
+    mesh = make_mesh({"seq": 8})
+    u_ring = _unit(mesh=mesh, heads=2, causal=True, t=16)
+    u_ring.initialize(device=Device(backend="cpu"))
+    u_ring.run()
+    assert numpy.allclose(u_ref.output.map_read(),
+                          u_ring.output.map_read(), atol=2e-5)
+
+
+def test_numpy_backend_forward():
+    """The host-twin path must carry ALL params (proj included)."""
+    u_dev = _unit()
+    u_dev.initialize(device=Device(backend="cpu"))
+    u_dev.run()
+    u_np = _unit()
+    u_np.initialize(device=Device(backend="numpy"))
+    u_np.run()
+    assert numpy.allclose(u_dev.output.map_read(),
+                          u_np.output.map_read(), atol=1e-5)
+
+
+def test_graph_mode_trains_and_updates_proj():
+    """Graph mode (per-unit GD) must update every attention param —
+    including proj, which the base weights/bias plumbing doesn't know."""
+    u = _unit()
+    u.initialize(device=Device(backend="cpu"))
+    u.run()
+    gd = GDMultiHeadAttention(u.workflow, learning_rate=0.1)
+    gd.link_forward(u)
+    gd.batch_size = u.input.shape[0]
+    rng = numpy.random.RandomState(4)
+    gd.err_output = Array(
+        rng.uniform(-1, 1, u.output.shape).astype(numpy.float32))
+    gd.need_err_input = False
+    gd.initialize(device=Device(backend="cpu"))
+    before = {k: numpy.asarray(v).copy()
+              for k, v in u.host_params.items()}
+    gd.run()
+    after = u.host_params
+    for name in ("weights", "proj", "bias"):
+        assert not numpy.allclose(before[name], after[name]), \
+            "%s did not update in graph mode" % name
+
+
+def test_attention_trains_in_standard_workflow():
+    """A task FC layers can't do without mixing positions: find the
+    marked position's payload token.  Attention must drive validation
+    error far under chance."""
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.loader.base import TEST, VALID, TRAIN
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+    from veles_tpu import prng
+
+    T, D, C = 8, 8, 4
+
+    class NeedleLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.RandomState(3)
+            n = 600
+            x = rng.uniform(-0.2, 0.2, (n, T, D)).astype(numpy.float32)
+            labels = rng.randint(0, C, n)
+            pos = rng.randint(0, T, n)
+            for i in range(n):
+                x[i, pos[i], 0] = 2.0            # the marker
+                x[i, pos[i], 1 + labels[i]] = 2.0  # the payload class
+            self.original_data.mem = x
+            self.original_labels = list(labels.astype(numpy.int32))
+            self.class_lengths[TEST] = 0
+            self.class_lengths[VALID] = 150
+            self.class_lengths[TRAIN] = 450
+
+    prng.get().seed(42)
+    wf = StandardWorkflow(
+        None, name="attn-wf",
+        loader_factory=NeedleLoader,
+        loader={"minibatch_size": 50,
+                "prng": RandomGenerator().seed(5)},
+        layers=[
+            {"type": "multihead_attention", "->": {"heads": 2},
+             "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": C},
+             "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+        ],
+        loss_function="softmax",
+        decision={"max_epochs": 25, "silent": True}, fused=True)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    res = wf.gather_results()
+    assert res["best_validation_error_pt"] < 40.0, res  # chance = 75

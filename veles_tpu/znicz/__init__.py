@@ -37,5 +37,7 @@ from . import activation                                 # noqa: F401
 from .misc_units import (Cutter, GDCutter, ChannelSplitter,
                          ChannelMerger, ZeroFiller, Deconv, GDDeconv,
                          Depooling)                      # noqa: F401
+from .attention import (MultiHeadAttention,
+                        GDMultiHeadAttention)            # noqa: F401
 from . import (image_saver, kohonen, lr_adjust, rbm,   # noqa: F401,E402
                rnn, rollback)

@@ -1,0 +1,137 @@
+"""Multi-head attention forward/backward units.
+
+Beyond the reference's 2015-era layer inventory (SURVEY.md §2.9 lists
+none — the platform predates transformers), but squarely inside its
+capability contract: "any topology the unit library can express, scaled
+past one device".  On TPU that means attention must exist as a
+first-class unit whose sequence dimension can shard over the mesh — the
+long-context path (parallel/ring.py ring attention) is wired in here,
+not bolted on.
+
+Layout: input [B, T, D]; packed QKV projection ``weights`` (D, 3D),
+output projection ``proj`` (D, D) + optional ``bias`` (D,).  The unit
+follows every ForwardBase contract (pure ``apply``, params pytree,
+export_params for the package archive), so it composes with
+StandardWorkflow, the fused/epoch-scan trainers, snapshots, and the
+mesh-sharded distributed step like any other layer; the backward is the
+generic VJP pair (graph mode and fused mode agree by construction).
+"""
+
+import numpy
+
+from ..memory import Array
+from .nn_units import ForwardBase, GradientDescentBase
+
+
+class MultiHeadAttention(ForwardBase):
+    """Self-attention over [B, T, D] sequences.
+
+    kwargs:
+      heads: number of attention heads (must divide D);
+      causal: autoregressive masking;
+      mesh/seq_axis/data_axis: when a ``jax.sharding.Mesh`` with a seq
+        axis is given, attention runs as RING attention over it
+        (sequence parallelism; parallel/ring.py) — the single-device
+        math is identical.
+    """
+
+    MAPPING = "multihead_attention"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.heads = int(kwargs.get("heads", 1))
+        self.causal = bool(kwargs.get("causal", False))
+        self.mesh = kwargs.get("mesh")
+        self.seq_axis = kwargs.get("seq_axis", "seq")
+        self.data_axis = kwargs.get("data_axis")
+        self.proj = Array()
+        self.exports = ["weights", "proj", "bias"]
+
+    def init_params(self):
+        b, t, d = self.input_shape
+        if d % self.heads:
+            raise ValueError("heads=%d must divide model dim %d"
+                             % (self.heads, d))
+        stddev = self.weights_stddev or 1.0 / numpy.sqrt(d)
+        self.fill_array(self.weights, (d, 3 * d), stddev,
+                        self.weights_filling)
+        self.fill_array(self.proj, (d, d), stddev, self.weights_filling)
+        if self.include_bias:
+            self.fill_array(self.bias, (d,), self.bias_stddev or stddev,
+                            self.bias_filling)
+
+    @property
+    def params(self):
+        p = {"weights": self.weights.devmem, "proj": self.proj.devmem}
+        if self.include_bias and self.bias:
+            p["bias"] = self.bias.devmem
+        return p
+
+    def set_params(self, params):
+        if "weights" in params:
+            self.weights.devmem = params["weights"]
+        if "proj" in params:
+            self.proj.devmem = params["proj"]
+        if "bias" in params:
+            self.bias.devmem = params["bias"]
+
+    @property
+    def host_params(self):
+        p = super().host_params
+        p["proj"] = self.proj.map_read()
+        return p
+
+    def set_host_params(self, params):
+        super().set_host_params(params)
+        if "proj" in params:
+            self.proj.mem = numpy.asarray(params["proj"], numpy.float32)
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def _attend(self, q, k, v):
+        from ..parallel.ring import attention_reference, ring_attention
+        if self.mesh is not None and self.seq_axis in self.mesh.shape:
+            return ring_attention(q, k, v, self.mesh,
+                                  seq_axis=self.seq_axis,
+                                  data_axis=self.data_axis,
+                                  causal=self.causal)
+        return attention_reference(q, k, v, causal=self.causal)
+
+    def apply(self, params, x):
+        b, t, d = x.shape
+        h = self.heads
+        qkv = x @ params["weights"]                     # [B, T, 3D]
+        q, k, v = (qkv[..., i * d:(i + 1) * d].reshape(b, t, h, d // h)
+                   for i in range(3))
+        out = self._attend(q, k, v).reshape(b, t, d)
+        y = out @ params["proj"]
+        if "bias" in params:
+            y = y + params["bias"]
+        return y
+
+    def apply_numpy(self, params, x):
+        import jax
+        return numpy.asarray(self.apply(
+            jax.tree.map(numpy.asarray, params), numpy.asarray(x)))
+
+    def export_params(self):
+        return {"heads": int(self.heads), "causal": bool(self.causal),
+                "include_bias": bool(self.include_bias)}
+
+
+class GDMultiHeadAttention(GradientDescentBase):
+    """Backward via the generic VJP of the forward's pure apply (the
+    same chain rule the fused trainer differentiates)."""
+
+    MAPPING = "multihead_attention"
+
+    def backward(self, params, x, y, err_output, n_valid=None):
+        if n_valid is None:
+            n_valid = x.shape[0]
+        return self.backward_via_vjp(params, x, err_output, n_valid)
+
+    def backward_numpy(self, params, x, y, err_output, n_valid=None):
+        err_in, grads = self.backward(params, x, y, err_output, n_valid)
+        return (numpy.asarray(err_in) if err_in is not None else None,
+                {k: numpy.asarray(v) for k, v in grads.items()})

@@ -109,6 +109,26 @@ class ForwardBase(NNUnitBase):
         if "bias" in params:
             self.bias.devmem = params["bias"]
 
+    @property
+    def host_params(self):
+        """Host (numpy) twin of :attr:`params` — the numpy backend and
+        the GD host path read through this, so units with extra
+        parameter tensors (attention's ``proj``) override params/
+        host_params as a pair."""
+        p = {}
+        if self.weights:
+            p["weights"] = self.weights.map_read()
+        if self.include_bias and self.bias:
+            p["bias"] = self.bias.map_read()
+        return p
+
+    def set_host_params(self, params):
+        if "weights" in params:
+            self.weights.mem = numpy.asarray(params["weights"],
+                                             numpy.float32)
+        if "bias" in params:
+            self.bias.mem = numpy.asarray(params["bias"], numpy.float32)
+
     def fill_array(self, arr, shape, stddev, filling):
         n_in = shape[0] if len(shape) > 1 else max(shape[0], 1)
         if stddev is None:
@@ -173,11 +193,7 @@ class ForwardBase(NNUnitBase):
     def numpy_run(self):
         x = self.input.map_read() if isinstance(self.input, Array) \
             else numpy.asarray(self.input)
-        params = {}
-        if self.weights:
-            params["weights"] = self.weights.map_read()
-        if self.include_bias and self.bias:
-            params["bias"] = self.bias.map_read()
+        params = self.host_params
         if self._graph_training():
             # replay the device draw exactly on host (jnp on CPU)
             self.output.mem = numpy.asarray(
@@ -315,20 +331,48 @@ class GradientDescentBase(NNUnitBase):
         return int(self.batch_size) if self.batch_size is not None \
             else x.shape[0]
 
+    def _gather_params(self, host):
+        """The forward's FULL param dict (overridable shapes like
+        attention's ``proj`` included); hardcoded weights/bias only when
+        no forward is linked (hand-built test graphs)."""
+        fwd = self.forward_unit
+        if fwd is not None:
+            return dict(fwd.host_params if host else fwd.params)
+        if host:
+            params = {"weights": self._host(self.weights)}
+            if self.bias:
+                params["bias"] = self._host(self.bias)
+            return params
+        params = {"weights": self.weights.devmem}
+        if self.bias:
+            params["bias"] = self.bias.devmem
+        return params
+
+    def _store_params(self, new_params, host):
+        fwd = self.forward_unit
+        if fwd is not None:
+            (fwd.set_host_params if host else fwd.set_params)(new_params)
+            return
+        if host:
+            self.weights.mem = numpy.asarray(new_params["weights"],
+                                             numpy.float32)
+            if self.bias and "bias" in new_params:
+                self.bias.mem = numpy.asarray(new_params["bias"],
+                                              numpy.float32)
+        else:
+            self.weights.devmem = new_params["weights"]
+            if self.bias and "bias" in new_params:
+                self.bias.devmem = new_params["bias"]
+
     def numpy_run(self):
         x = self._host(self.input)
         y = self._host(self.output)
         err_out = self._host(self.err_output)
-        params = {"weights": self._host(self.weights)}
-        if self.bias:
-            params["bias"] = self._host(self.bias)
+        params = self._gather_params(host=True)
         err_in, grads = self.backward_numpy(params, x, y, err_out,
                                             self._n_valid(x))
         new_params = self.apply_updates(params, grads, numpy)
-        self.weights.mem = numpy.asarray(new_params["weights"],
-                                         numpy.float32)
-        if self.bias and "bias" in new_params:
-            self.bias.mem = numpy.asarray(new_params["bias"], numpy.float32)
+        self._store_params(new_params, host=True)
         if self.need_err_input:
             self.err_input.mem = numpy.asarray(err_in, numpy.float32)
 
@@ -345,15 +389,11 @@ class GradientDescentBase(NNUnitBase):
         x = self._dev(self.input)
         y = self._dev(self.output)
         err_out = self._dev(self.err_output)
-        params = {"weights": self.weights.devmem}
-        if self.bias:
-            params["bias"] = self.bias.devmem
+        params = self._gather_params(host=False)
         err_in, grads = self._jitted_bwd_(params, x, y, err_out,
                                           n_valid=self._n_valid(x))
         new_params = self.apply_updates(params, grads, jnp)
-        self.weights.devmem = new_params["weights"]
-        if self.bias and "bias" in new_params:
-            self.bias.devmem = new_params["bias"]
+        self._store_params(new_params, host=False)
         if self.need_err_input:
             self.err_input.devmem = err_in
 
