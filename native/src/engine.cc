@@ -334,6 +334,105 @@ class LRNUnit : public Unit {
 // ---------------------------------------------------------------------------
 // Identity (inference-time dropout)
 
+// ---------------------------------------------------------------------------
+// MultiHeadAttention: [B, T, D] self-attention, packed QKV (D, 3D) +
+// output projection (D, D); mirrors znicz/attention.py apply()
+
+class MultiHeadAttentionUnit : public Unit {
+ public:
+  MultiHeadAttentionUnit(const Json& cfg, NpyArray w, NpyArray proj,
+                         NpyArray bias, bool has_bias)
+      : w_(std::move(w)), proj_(std::move(proj)), b_(std::move(bias)),
+        has_bias_(has_bias) {
+    heads_ = cfg.has("heads") ? static_cast<size_t>(cfg["heads"].number)
+                              : 1;
+    causal_ = cfg.has("causal") && cfg["causal"].boolean;
+  }
+
+  void Run(const Tensor& in, Tensor* out) const override {
+    RequireRank(in, 3, "multihead_attention");
+    size_t B = in.shape[0], T = in.shape[1], D = in.shape[2];
+    if (w_.shape.size() != 2 || w_.shape[0] != D || w_.shape[1] != 3 * D)
+      throw std::runtime_error("attention qkv weights must be (D, 3D)");
+    if (proj_.shape.size() != 2 || proj_.shape[0] != D ||
+        proj_.shape[1] != D)
+      throw std::runtime_error("attention proj weights must be (D, D)");
+    if (has_bias_ && b_.data.size() < D)
+      throw std::runtime_error("attention bias shorter than model dim");
+    if (heads_ == 0 || D % heads_)
+      throw std::runtime_error("attention heads must divide model dim");
+    size_t H = heads_, Dh = D / H;
+    float scale = 1.0f / std::sqrt(static_cast<float>(Dh));
+    std::vector<float> qkv(B * T * 3 * D, 0.0f);
+    const float* x = in.data.data();
+    const float* w = w_.data.data();
+    for (size_t bt = 0; bt < B * T; ++bt) {
+      const float* xr = x + bt * D;
+      float* qr = qkv.data() + bt * 3 * D;
+      for (size_t i = 0; i < D; ++i) {
+        float xv = xr[i];
+        if (xv == 0.0f) continue;
+        const float* wr = w + i * 3 * D;
+        for (size_t j = 0; j < 3 * D; ++j) qr[j] += xv * wr[j];
+      }
+    }
+    // attention per (batch, head); qkv row layout: [q(D) k(D) v(D)]
+    std::vector<float> ctx(B * T * D, 0.0f);
+    std::vector<float> scores(T);
+    for (size_t b = 0; b < B; ++b) {
+      for (size_t h = 0; h < H; ++h) {
+        size_t off = h * Dh;
+        for (size_t tq = 0; tq < T; ++tq) {
+          const float* q = qkv.data() + (b * T + tq) * 3 * D + off;
+          size_t t_max = causal_ ? tq + 1 : T;
+          float mx = -std::numeric_limits<float>::infinity();
+          for (size_t tk = 0; tk < t_max; ++tk) {
+            const float* k = qkv.data() + (b * T + tk) * 3 * D + D + off;
+            float s = 0.0f;
+            for (size_t i = 0; i < Dh; ++i) s += q[i] * k[i];
+            scores[tk] = s * scale;
+            mx = std::max(mx, scores[tk]);
+          }
+          float denom = 0.0f;
+          for (size_t tk = 0; tk < t_max; ++tk) {
+            scores[tk] = std::exp(scores[tk] - mx);
+            denom += scores[tk];
+          }
+          float* o = ctx.data() + (b * T + tq) * D + off;
+          for (size_t tk = 0; tk < t_max; ++tk) {
+            float p = scores[tk] / denom;
+            const float* v =
+                qkv.data() + (b * T + tk) * 3 * D + 2 * D + off;
+            for (size_t i = 0; i < Dh; ++i) o[i] += p * v[i];
+          }
+        }
+      }
+    }
+    // output projection (+ bias)
+    out->shape = {B, T, D};
+    out->data.assign(B * T * D, 0.0f);
+    const float* pw = proj_.data.data();
+    for (size_t bt = 0; bt < B * T; ++bt) {
+      const float* cr = ctx.data() + bt * D;
+      float* yr = out->data.data() + bt * D;
+      for (size_t i = 0; i < D; ++i) {
+        float cv = cr[i];
+        if (cv == 0.0f) continue;
+        const float* pr = pw + i * D;
+        for (size_t j = 0; j < D; ++j) yr[j] += cv * pr[j];
+      }
+      if (has_bias_)
+        for (size_t j = 0; j < D; ++j) yr[j] += b_.data[j];
+    }
+  }
+
+ private:
+  size_t heads_;
+  bool causal_;
+  NpyArray w_, proj_, b_;
+  bool has_bias_;
+};
+
 class IdentityUnit : public Unit {
  public:
   void Run(const Tensor& in, Tensor* out) const override { *out = in; }
@@ -407,6 +506,19 @@ bool RegisterBuiltins() {
   reg.Register("DropoutForward",
                [](const Json&, std::map<std::string, NpyArray>) {
                  return std::unique_ptr<Unit>(new IdentityUnit());
+               });
+  reg.Register("MultiHeadAttention",
+               [](const Json& cfg, std::map<std::string, NpyArray> arrays) {
+                 NpyArray w = TakeArray(&arrays, "weights");
+                 NpyArray proj = TakeArray(&arrays, "proj");
+                 NpyArray b = TakeArray(&arrays, "bias");
+                 bool has_bias = !b.data.empty();
+                 if (cfg.has("include_bias") &&
+                     !cfg["include_bias"].boolean)
+                   has_bias = false;
+                 return std::unique_ptr<Unit>(new MultiHeadAttentionUnit(
+                     cfg, std::move(w), std::move(proj), std::move(b),
+                     has_bias));
                });
   // standalone activation units (znicz/activation.py Forward* family)
   for (const char* cls : {"ForwardTanh", "ForwardSigmoid", "ForwardRELU",

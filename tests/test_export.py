@@ -125,3 +125,49 @@ def test_native_conv_stack(native_build, tmp_path):
     out = NativeWorkflow(path).run(x)
     assert out.shape == live.shape
     assert numpy.abs(out - live).max() < 5e-4
+
+
+def test_native_attention(native_build, tmp_path):
+    """MultiHeadAttention flows through the native engine: export a
+    trained attention+softmax net, native logits == live logits."""
+    import jax
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.loader.base import TEST, VALID, TRAIN
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class SeqLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.RandomState(5)
+            x = rng.uniform(-1, 1, (80, 6, 8)).astype(numpy.float32)
+            self.original_data.mem = x
+            self.original_labels = list(
+                rng.randint(0, 3, 80).astype(numpy.int32))
+            self.class_lengths[TEST] = 0
+            self.class_lengths[VALID] = 20
+            self.class_lengths[TRAIN] = 60
+
+    wf = StandardWorkflow(
+        None, name="attn-export",
+        loader_factory=SeqLoader,
+        loader={"minibatch_size": 20,
+                "prng": RandomGenerator().seed(6)},
+        layers=[
+            {"type": "multihead_attention",
+             "->": {"heads": 2, "causal": True},
+             "<-": {"learning_rate": 0.01}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.01}},
+        ],
+        loss_function="softmax",
+        decision={"max_epochs": 1, "silent": True}, fused=True)
+    wf.initialize(device=Device(backend="auto"))
+    wf.run()
+    path = str(tmp_path / "attn.zip")
+    export_model(wf, path)
+    x = numpy.asarray(wf.loader.original_data.map_read()[:4])
+    live = numpy.asarray(jax.jit(forward_fn(wf.forwards))(
+        [f.params for f in wf.forwards], x))
+    from veles_tpu.export.native import NativeWorkflow
+    out = NativeWorkflow(path).run(x)
+    assert out.shape == live.shape
+    assert numpy.abs(out - live).max() < 5e-4

@@ -110,11 +110,6 @@ class MultiHeadAttention(ForwardBase):
             y = y + params["bias"]
         return y
 
-    def apply_numpy(self, params, x):
-        import jax
-        return numpy.asarray(self.apply(
-            jax.tree.map(numpy.asarray, params), numpy.asarray(x)))
-
     def export_params(self):
         return {"heads": int(self.heads), "causal": bool(self.causal),
                 "include_bias": bool(self.include_bias)}
