@@ -332,9 +332,6 @@ class LRNUnit : public Unit {
 };
 
 // ---------------------------------------------------------------------------
-// Identity (inference-time dropout)
-
-// ---------------------------------------------------------------------------
 // MultiHeadAttention: [B, T, D] self-attention, packed QKV (D, 3D) +
 // output projection (D, D); mirrors znicz/attention.py apply()
 
@@ -432,6 +429,9 @@ class MultiHeadAttentionUnit : public Unit {
   NpyArray w_, proj_, b_;
   bool has_bias_;
 };
+
+// ---------------------------------------------------------------------------
+// Identity (inference-time dropout)
 
 class IdentityUnit : public Unit {
  public:

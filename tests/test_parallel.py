@@ -168,6 +168,33 @@ def test_tp_conv_equals_dp():
         wf_t.decision.best_n_err_pt, abs=1e-9)
 
 
+def test_mesh_epoch_scan_equals_single_scan():
+    """epoch_scan over a mesh (DistributedScanStep): one scan dispatch
+    per class, batch split over data, params replicated — must train
+    the same weights as the single-device scan AND the per-step mesh."""
+    wf_s = build(epoch_scan=True)
+    wf_m = build(mesh=make_mesh({"data": 8}), epoch_scan=True)
+    wf_s.run()
+    wf_m.run()
+    for fs, fm in zip(wf_s.forwards, wf_m.forwards):
+        assert numpy.allclose(fs.weights.map_read(), fm.weights.map_read(),
+                              atol=2e-5), type(fs).__name__
+    assert wf_s.decision.best_n_err_pt == pytest.approx(
+        wf_m.decision.best_n_err_pt, abs=1e-9)
+
+
+def test_mesh_epoch_scan_with_tp():
+    """dp x tp sharded scan trains to the same result as DP scan."""
+    wf_d = build(mesh=make_mesh({"data": 8}), epoch_scan=True)
+    wf_t = build(mesh=make_mesh({"data": 4, "model": 2}),
+                 model_axis="model", epoch_scan=True)
+    wf_d.run()
+    wf_t.run()
+    for fd, ft in zip(wf_d.forwards, wf_t.forwards):
+        assert numpy.allclose(fd.weights.map_read(), ft.weights.map_read(),
+                              atol=2e-5), type(fd).__name__
+
+
 def test_megatron_tp_equals_dp():
     """Megatron col/row alternation is a layout change only: training
     must match pure DP exactly (within f32 reduction noise)."""

@@ -36,7 +36,6 @@ class DistributedTrainStep(FusedTrainStep):
         super().initialize(device=device, **kwargs)
         import jax
         import numpy
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         m = self.mesh
         multihost = jax.process_count() > 1
@@ -48,23 +47,10 @@ class DistributedTrainStep(FusedTrainStep):
             self._params_ = jax.tree.map(numpy.asarray, self._params_)
             self._opt_ = jax.tree.map(numpy.asarray, self._opt_)
             self._macc_ = jax.tree.map(numpy.asarray, self._macc_)
-        if self.model_axis and self.model_axis in m.shape:
-            param_shard = mesh_mod.tensor_parallel_sharding(
-                m, self._params_, self.model_axis, mode=self.tp_mode)
-        else:
-            param_shard = mesh_mod.data_parallel_sharding(m, self._params_)
-        # opt state shards like its param (momentum buffers are
-        # param-shaped; adadelta tuples too)
-        opt_shard = [
-            {name: tuple(param_shard[i][name]
-                         for _ in range(len(self._opt_[i][name])))
-             if isinstance(self._opt_[i][name], tuple)
-             else param_shard[i][name]
-             for name in self._opt_[i]}
-            for i in range(len(self._opt_))]
+        param_shard, opt_shard, scalar = mesh_mod.trainer_shardings(
+            m, self._params_, self._opt_, self.model_axis, self.tp_mode)
         batch_shard = mesh_mod.batch_sharding(m, self.data_axis)
         label_shard = batch_shard
-        scalar = NamedSharding(m, P())
 
         self._params_ = jax.device_put(self._params_, param_shard)
         self._opt_ = jax.device_put(self._opt_, opt_shard)

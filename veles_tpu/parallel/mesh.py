@@ -46,6 +46,29 @@ def replicated(mesh):
     return NamedSharding(mesh, P())
 
 
+def trainer_shardings(mesh, params, opt, model_axis=None,
+                      tp_mode="column"):
+    """The fused trainers' operand shardings: params tensor-sharded over
+    ``model_axis`` when given (else replicated DP), opt-state entries
+    shaped like their param (momentum buffers, adadelta tuples), plus
+    the replicated spec for scalars/metrics.  Shared by the per-step
+    (parallel/dp.py) and epoch-scan (parallel/scan.py) mesh trainers."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if model_axis and model_axis in mesh.shape:
+        param_shard = tensor_parallel_sharding(mesh, params, model_axis,
+                                               mode=tp_mode)
+    else:
+        param_shard = data_parallel_sharding(mesh, params)
+    opt_shard = [
+        {name: tuple(param_shard[i][name]
+                     for _ in range(len(opt[i][name])))
+         if isinstance(opt[i][name], tuple)
+         else param_shard[i][name]
+         for name in opt[i]}
+        for i in range(len(opt))]
+    return param_shard, opt_shard, NamedSharding(mesh, P())
+
+
 def data_parallel_sharding(mesh, params_tree):
     """Replicate every param (pure DP)."""
     import jax

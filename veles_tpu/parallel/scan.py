@@ -1,0 +1,89 @@
+"""DistributedScanStep: the epoch-scan trainer sharded over a Mesh.
+
+Composes the two big levers: the epoch-scan path (one ``lax.scan``
+dispatch per class/epoch block — znicz/scan_step.py) and mesh SPMD
+(params replicated or tensor-sharded, batch split over ``data``, XLA
+inserting the gradient all-reduce — parallel/dp.py).  The HBM-resident
+dataset is REPLICATED across the mesh (every shard gathers its own
+minibatch rows, then a sharding constraint splits the batch); for
+datasets too large to replicate, use the per-step DistributedTrainStep
+whose host gather feeds shards, or shard the dataset upstream.
+
+Single-process meshes only (the scan's bulk index tensors are built
+host-side); multi-host training goes through DistributedTrainStep.
+"""
+
+from ..znicz.scan_step import ScanEpochStep
+from . import mesh as mesh_mod
+
+
+class DistributedScanStep(ScanEpochStep):
+    """ScanEpochStep over a Mesh: dp/tp shardings, scan dispatch."""
+
+    def __init__(self, workflow, forwards, gd_units, mesh,
+                 loss="softmax", data_axis="data", model_axis=None,
+                 tp_mode="column", **kwargs):
+        super().__init__(workflow, forwards, gd_units, loss=loss, **kwargs)
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+        self.tp_mode = tp_mode
+
+    def initialize(self, device=None, **kwargs):
+        import jax
+        if jax.process_count() > 1:
+            raise ValueError(
+                "epoch_scan over a mesh is single-process only (the bulk "
+                "scan index tensors are host-built); multi-host training "
+                "uses the per-step DistributedTrainStep (drop "
+                "epoch_scan=)")
+        super().initialize(device=device, **kwargs)
+
+    # ScanEpochStep.initialize calls these AFTER the params/opt/macc and
+    # the resident dataset exist, so the shardings can be computed and
+    # the operands placed right here.
+    def _place_operands(self):
+        import jax
+        if getattr(self, "_placed_", False):
+            return
+        param_shard, opt_shard, rep = mesh_mod.trainer_shardings(
+            self.mesh, self._params_, self._opt_, self.model_axis,
+            self.tp_mode)
+        self._param_shard_, self._opt_shard_, self._rep_ = \
+            param_shard, opt_shard, rep
+        self._params_ = jax.device_put(self._params_, param_shard)
+        self._opt_ = jax.device_put(self._opt_, opt_shard)
+        self._macc_ = jax.device_put(self._macc_, rep)
+        # the dataset gathers shard-locally: replicate it + the labels
+        self._data_dev_ = jax.device_put(self._data_dev_, rep)
+        self._y_dev_ = jax.device_put(self._y_dev_, rep)
+        self._placed_ = True
+
+    def _constrain_batch(self, a):
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = (self.data_axis,) + (None,) * (a.ndim - 1)
+        return lax.with_sharding_constraint(
+            a, NamedSharding(self.mesh, P(*spec)))
+
+    def _jit_train_scan(self, train_scan):
+        import jax
+        self._place_operands()
+        rep = self._rep_
+        return jax.jit(
+            train_scan,
+            in_shardings=(rep, rep, self._param_shard_, self._opt_shard_,
+                          rep, rep, rep, rep, rep),
+            out_shardings=(self._param_shard_, self._opt_shard_, rep,
+                           rep),
+            donate_argnums=(2, 3, 4))
+
+    def _jit_eval_scan(self, eval_scan):
+        import jax
+        self._place_operands()
+        rep = self._rep_
+        return jax.jit(
+            eval_scan,
+            in_shardings=(rep, rep, self._param_shard_, rep, rep, rep),
+            out_shardings=(rep, rep),
+            donate_argnums=(3,))

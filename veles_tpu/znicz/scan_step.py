@@ -70,8 +70,8 @@ class ScanEpochStep(FusedTrainStep):
             def body(carry, batch):
                 p, o, m = carry
                 bidx, bsize, bseed = batch
-                x = jnp.take(data_dev, bidx, axis=0)
-                y = jnp.take(y_dev, bidx, axis=0)
+                x = self._constrain_batch(jnp.take(data_dev, bidx, axis=0))
+                y = self._constrain_batch(jnp.take(y_dev, bidx, axis=0))
                 p, o, m, loss, _ = train(p, o, m, x, y, bsize, bseed,
                                          lr_scale)
                 return (p, o, m), loss
@@ -82,15 +82,29 @@ class ScanEpochStep(FusedTrainStep):
         def eval_scan(data_dev, y_dev, params, macc, idx, sizes):
             def body(m, batch):
                 bidx, bsize = batch
-                x = jnp.take(data_dev, bidx, axis=0)
-                y = jnp.take(y_dev, bidx, axis=0)
+                x = self._constrain_batch(jnp.take(data_dev, bidx, axis=0))
+                y = self._constrain_batch(jnp.take(y_dev, bidx, axis=0))
                 m, loss, _ = evaluate(params, m, x, y, bsize)
                 return m, loss
             macc, losses = lax.scan(body, macc, (idx, sizes))
             return macc, losses
 
-        self._train_scan_ = jax.jit(train_scan, donate_argnums=(2, 3, 4))
-        self._eval_scan_ = jax.jit(eval_scan, donate_argnums=(3,))
+        self._train_scan_ = self._jit_train_scan(train_scan)
+        self._eval_scan_ = self._jit_eval_scan(eval_scan)
+
+    # -- sharding hooks (overridden by parallel.DistributedScanStep) --------
+    def _constrain_batch(self, a):
+        """Per-minibatch sharding constraint inside the scan body; the
+        single-device step leaves arrays alone."""
+        return a
+
+    def _jit_train_scan(self, train_scan):
+        import jax
+        return jax.jit(train_scan, donate_argnums=(2, 3, 4))
+
+    def _jit_eval_scan(self, eval_scan):
+        import jax
+        return jax.jit(eval_scan, donate_argnums=(3,))
 
     def _next_seeds(self, n):
         """Deterministic consecutive per-batch seeds (matches the per-step

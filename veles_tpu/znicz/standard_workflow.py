@@ -247,17 +247,34 @@ class StandardWorkflow(Workflow):
         # through them
         for fwd in self.forwards:
             fwd.unlink_all()
-        if self.mesh is not None and self.epoch_scan:
-            raise ValueError(
-                "epoch_scan over a mesh is not implemented yet; pass one "
-                "of mesh= or epoch_scan=")
         from .misc_units import ZeroFiller
         for fwd in self.forwards:
             if isinstance(fwd, ZeroFiller):
                 raise ValueError(
                     "zero_filler is graph-mode only; use Conv(grouping=N) "
                     "in fused workflows (see ZeroFiller docstring)")
-        if self.mesh is not None:
+        if self.epoch_scan:
+            from ..mutable import Bool
+            if self.mesh is not None:
+                # the two big levers composed: one scan dispatch per
+                # class AND dp/tp shardings over the mesh
+                from ..parallel.scan import DistributedScanStep
+                self.fused_step = DistributedScanStep(
+                    self, self.forwards, self.gds, mesh=self.mesh,
+                    loss=self.loss_function, model_axis=self.model_axis,
+                    tp_mode=self.tp_mode, **self.trainer_config)
+            else:
+                from .scan_step import ScanEpochStep
+                self.fused_step = ScanEpochStep(
+                    self, self.forwards, self.gds,
+                    loss=self.loss_function, **self.trainer_config)
+            # the scan step drives the loader itself; the loader stays
+            # linked (so it initializes before the scan step in dependency
+            # order) but permanently blocked from running
+            self.loader.gate_block = Bool(True)
+            self.fused_step.link_from(self.repeater)
+            self.fused_step.link_scan_loader(self.loader)
+        elif self.mesh is not None:
             from ..parallel.dp import DistributedTrainStep
             self.fused_step = DistributedTrainStep(
                 self, self.forwards, self.gds, mesh=self.mesh,
@@ -265,18 +282,6 @@ class StandardWorkflow(Workflow):
                 tp_mode=self.tp_mode, **self.trainer_config)
             self.fused_step.link_from(self.loader)
             self.fused_step.link_loader(self.loader)
-        elif self.epoch_scan:
-            from ..mutable import Bool
-            from .scan_step import ScanEpochStep
-            self.fused_step = ScanEpochStep(
-                self, self.forwards, self.gds, loss=self.loss_function,
-                **self.trainer_config)
-            # the scan step drives the loader itself; the loader stays
-            # linked (so it initializes before the scan step in dependency
-            # order) but permanently blocked from running
-            self.loader.gate_block = Bool(True)
-            self.fused_step.link_from(self.repeater)
-            self.fused_step.link_scan_loader(self.loader)
         else:
             self.fused_step = FusedTrainStep(
                 self, self.forwards, self.gds, loss=self.loss_function,
