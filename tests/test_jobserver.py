@@ -134,6 +134,50 @@ def test_worker_process_crash_requeue_and_respawn(tmp_path):
         master.close()
 
 
+def test_secret_handshake():
+    """A master with a secret serves matching workers and rejects
+    mismatched tokens (the cross-host auth story)."""
+    master = JobMaster(secret="s3cret", silent=True)
+    try:
+        t = threading.Thread(
+            target=worker_loop, args=(master.address[0], master.address[1]),
+            kwargs={"name": "good", "secret": "s3cret"}, daemon=True)
+        t.start()
+
+        def bad():
+            worker_loop(master.address[0], master.address[1],
+                        name="bad", secret="wrong")
+
+        threading.Thread(target=bad, daemon=True).start()
+        results = master.map([{"kind": "eval", "value": i}
+                              for i in range(4)], timeout=30)
+        assert all(r["rc"] == 0 for r in results)
+        assert {r["worker"] for r in results} == {"good"}
+        assert master.active_workers <= 1  # the bad worker was dropped
+    finally:
+        master.close()
+
+
+def test_worker_pool_custom_command_template():
+    """The launch template ({host}/{port} substitution) is the remote
+    (SSH) spawn hook; exercised with a local python command."""
+    import sys as sys_mod
+    master = JobMaster(silent=True)
+    pool = None
+    try:
+        pool = WorkerPool(
+            master.address, n=1,
+            command=[sys_mod.executable, "-m", "veles_tpu.jobserver",
+                     "{host}", "{port}", "--name", "templated"])
+        results = master.map([{"kind": "eval", "value": 5}], timeout=30)
+        assert results[0]["rc"] == 0
+        assert results[0]["worker"] == "templated"
+    finally:
+        if pool is not None:
+            pool.close()
+        master.close()
+
+
 def test_execute_payload_unknown_kind():
     out = execute_payload({"kind": "nope"})
     assert out["rc"] == -2 and "unknown payload kind" in out["error"]
