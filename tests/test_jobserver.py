@@ -139,21 +139,25 @@ def test_secret_handshake():
     mismatched tokens (the cross-host auth story)."""
     master = JobMaster(secret="s3cret", silent=True)
     try:
-        t = threading.Thread(
+        # the bad worker FIRST, synchronously: the master closes its
+        # connection on the failed token check, which makes worker_loop
+        # return — so a successful join IS the observed rejection
+        bad = threading.Thread(
             target=worker_loop, args=(master.address[0], master.address[1]),
-            kwargs={"name": "good", "secret": "s3cret"}, daemon=True)
-        t.start()
-
-        def bad():
-            worker_loop(master.address[0], master.address[1],
-                        name="bad", secret="wrong")
-
-        threading.Thread(target=bad, daemon=True).start()
+            kwargs={"name": "bad", "secret": "wrong"}, daemon=True)
+        bad.start()
+        bad.join(10)
+        assert not bad.is_alive(), "bad-token worker was not disconnected"
+        assert master.workers_seen == 0  # never admitted
+        threading.Thread(
+            target=worker_loop, args=(master.address[0], master.address[1]),
+            kwargs={"name": "good", "secret": "s3cret"},
+            daemon=True).start()
         results = master.map([{"kind": "eval", "value": i}
                               for i in range(4)], timeout=30)
         assert all(r["rc"] == 0 for r in results)
         assert {r["worker"] for r in results} == {"good"}
-        assert master.active_workers <= 1  # the bad worker was dropped
+        assert master.workers_seen == 1
     finally:
         master.close()
 
