@@ -133,7 +133,7 @@ CONV_LAYERS = [
 
 
 def build_conv(mesh=None, model_axis=None, max_epochs=2, minibatch=40,
-               seed=23):
+               seed=23, **extra):
     import veles_tpu.prng.random_generator as rg
     rg._generators.clear()
     rg.get(0).seed(seed)
@@ -144,7 +144,7 @@ def build_conv(mesh=None, model_axis=None, max_epochs=2, minibatch=40,
                 "prng": RandomGenerator().seed(5)},
         layers=CONV_LAYERS, loss_function="softmax",
         decision={"max_epochs": max_epochs, "silent": True},
-        fused=True, mesh=mesh, model_axis=model_axis)
+        fused=True, mesh=mesh, model_axis=model_axis, **extra)
     wf.initialize(device=Device(backend="cpu"))
     return wf
 
@@ -235,6 +235,23 @@ def test_megatron_sharding_alternates():
     with pytest.raises(ValueError, match="tp mode"):
         tensor_parallel_sharding(mesh, {"weights": numpy.zeros((4, 4))},
                                  "model", mode="megatorn")
+
+
+def test_mesh_epoch_scan_conv_stack():
+    """The north-star model class (conv) through the mesh scan path:
+    dp x tp sharded scan == single-device scan on the conv stack."""
+    wf_s = build_conv(epoch_scan=True)
+    wf_m = build_conv(mesh=make_mesh({"data": 4, "model": 2}),
+                      model_axis="model", epoch_scan=True)
+    wf_s.run()
+    wf_m.run()
+    for fs, fm in zip(wf_s.forwards, wf_m.forwards):
+        if not fs.params:
+            continue
+        assert numpy.allclose(fs.weights.map_read(), fm.weights.map_read(),
+                              atol=2e-5), type(fs).__name__
+    assert wf_s.decision.best_n_err_pt == pytest.approx(
+        wf_m.decision.best_n_err_pt, abs=1e-9)
 
 
 def test_conv_kernel_sharding_spec():

@@ -1,0 +1,111 @@
+"""Expert parallelism: switch-style top-1 mixture-of-experts over a
+mesh axis.
+
+The last of the mesh quintet (data/tensor/pipeline/sequence/expert):
+E experts' parameters shard over the ``expert`` axis — each device owns
+ONE expert and computes only the tokens routed to it (bounded by a
+capacity), so expert compute scales with the axis instead of
+replicating.  Routing is switch-transformer top-1: a linear router,
+softmax gate, tokens over capacity dropped (the standard trade;
+capacity_factor sizes the buffer).  The combine is a masked ``psum`` —
+every token's result lives on exactly one expert shard.
+
+Tokens (x) are replicated over the expert axis (and split over ``data``
+when composed dp x ep); an ``all_to_all`` dispatch variant for
+token-sharded inputs is the scale-up path once token counts outgrow
+replication.  Autodiff flows through routing (straight-through on the
+gate probability), so the layer trains end-to-end
+(tests/test_moe.py)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def router_probs(wr, x):
+    """[B, E] softmax router probabilities."""
+    return jax.nn.softmax(x @ wr, axis=-1)
+
+
+def moe_reference(expert_apply, stacked_params, wr, x, capacity):
+    """Single-device oracle: same top-1 routing, same capacity drops,
+    experts applied in a scan."""
+    e = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    probs = router_probs(wr, x)
+    assign = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, assign[:, None], axis=1)[:, 0]
+    out = jnp.zeros_like(expert_apply(
+        jax.tree.map(lambda p: p[0], stacked_params), x))
+
+    def per_expert(out, i):
+        params_i = jax.tree.map(lambda p: p[i], stacked_params)
+        mine = assign == i
+        pos = jnp.cumsum(mine) - 1
+        keep = jnp.logical_and(mine, pos < capacity)
+        y = expert_apply(params_i, x)
+        return out + jnp.where(keep[:, None], y, 0.0), None
+
+    out, _ = lax.scan(per_expert, out, jnp.arange(e))
+    return out * gate[:, None]
+
+
+def _moe_local(stacked_params, wr, x, *, expert_apply, capacity,
+               axis_name):
+    e_idx = lax.axis_index(axis_name)
+    params_e = jax.tree.map(lambda p: p[0], stacked_params)
+    b, d = x.shape
+    probs = router_probs(wr, x)
+    assign = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, assign[:, None], axis=1)[:, 0]
+    mine = assign == e_idx
+    pos = jnp.cumsum(mine) - 1                  # queue slot per token
+    keep = jnp.logical_and(mine, pos < capacity)
+    # pack this expert's tokens into a [capacity, D] buffer (one extra
+    # trash row absorbs everything dropped or foreign)
+    slot = jnp.where(keep, pos, capacity)
+    buf = jnp.zeros((capacity + 1, d), x.dtype).at[slot].set(x)
+    y = expert_apply(params_e, buf[:capacity])
+    # unpack: token i reads its slot's row; non-kept tokens contribute 0
+    out = jnp.where(keep[:, None],
+                    y[jnp.clip(pos, 0, capacity - 1)], 0.0)
+    out = out * gate[:, None]
+    # each token was computed on exactly one expert shard
+    return lax.psum(out, axis_name)
+
+
+def moe_apply(expert_apply, stacked_params, wr, x, mesh,
+              expert_axis="expert", data_axis=None,
+              capacity_factor=1.25):
+    """Expert-parallel top-1 MoE over ``mesh[expert_axis]``.
+
+    expert_apply(params_i, h[B, D]) -> [B, D']; ``stacked_params``
+    leading dim = E (sharded over the expert axis); ``wr`` [D, E]
+    replicated router weights; ``x`` [B, D] (B over ``data_axis`` when
+    given).  capacity = ceil(B/E * capacity_factor) tokens per expert,
+    overflow dropped exactly like the reference oracle."""
+    from jax.sharding import PartitionSpec as P
+    n_experts = mesh.shape[expert_axis]
+    stacked_e = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if stacked_e != n_experts or wr.shape[1] != n_experts:
+        # a divisible mismatch would shard "evenly" and silently zero
+        # every token routed to an expert no device owns
+        raise ValueError(
+            "expert count mismatch: params stack %d, router %d, mesh "
+            "axis %d" % (stacked_e, wr.shape[1], n_experts))
+    local_b = x.shape[0] // (mesh.shape[data_axis] if data_axis else 1)
+    capacity = moe_capacity(local_b, n_experts, capacity_factor)
+    param_spec = jax.tree.map(lambda _: P(expert_axis), stacked_params)
+    fn = jax.shard_map(
+        functools.partial(_moe_local, expert_apply=expert_apply,
+                          capacity=capacity, axis_name=expert_axis),
+        mesh=mesh,
+        in_specs=(param_spec, P(), P(data_axis)),
+        out_specs=P(data_axis))
+    return fn(stacked_params, wr, x)
+
+
+def moe_capacity(batch, n_experts, capacity_factor=1.25):
+    """The per-expert token budget moe_apply uses (for tests/sizing)."""
+    return max(1, int(-(-batch * capacity_factor // n_experts)))
