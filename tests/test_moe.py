@@ -99,3 +99,15 @@ def test_moe_rejects_expert_mismatch():
     mesh = make_mesh({"expert": 4}, devices=jax.devices()[:4])
     with pytest.raises(ValueError, match="expert count mismatch"):
         moe_apply(_expert, params, wr, x, mesh)
+
+
+def test_load_balance_loss_prefers_uniform_routing():
+    from veles_tpu.parallel.moe import load_balance_loss
+    rng = numpy.random.RandomState(7)
+    x = jnp.asarray(rng.standard_normal((64, 8)), jnp.float32)
+    wr_uniform = jnp.zeros((8, 4), jnp.float32)   # all experts equal
+    wr_collapsed = jnp.zeros((8, 4), jnp.float32).at[:, 0].set(10.0)
+    near_uniform = float(load_balance_loss(wr_uniform, x))
+    collapsed = float(load_balance_loss(wr_collapsed, x))
+    assert collapsed > 3.5                 # ~E when everything routes to 1
+    assert near_uniform < collapsed * 0.5  # balanced routing scores lower

@@ -4,13 +4,18 @@ The mental model follows the public scaling playbook: pick a mesh, annotate
 shardings on params and batch, let XLA insert the collectives, profile,
 iterate.  Axis conventions:
 
-- ``data``  — batch (data parallelism; gradient psum over this axis)
-- ``model`` — hidden/feature dims (tensor parallelism)
-- ``seq``   — sequence dim (context parallelism / ring attention)
+- ``data``   — batch (data parallelism; gradient psum over this axis)
+- ``model``  — hidden/feature dims (tensor parallelism)
+- ``seq``    — sequence dim (ring attention, parallel/ring.py)
+- ``pipe``   — pipeline stages (GPipe schedule, parallel/pipeline.py)
+- ``expert`` — MoE experts (switch routing, parallel/moe.py)
 
-A mesh is laid out so ``data`` spans the slowest-varying device dimension
-(DCN across slices in a real pod) and ``model`` the fastest (ICI
-neighbors).
+A mesh is laid out so ``data`` spans the slowest-varying device
+dimension (DCN across slices in a real pod) and the ppermute-ring axes
+(``model``, and especially ``seq``/``pipe`` whose hops are
+neighbor-to-neighbor every tick) the fastest (ICI neighbors);
+``expert`` sits between — its psum combine is bandwidth-bound but not
+latency-critical.
 """
 
 import numpy

@@ -29,6 +29,23 @@ def router_probs(wr, x):
     return jax.nn.softmax(x @ wr, axis=-1)
 
 
+def load_balance_loss(wr, x):
+    """The switch-transformer auxiliary balancing loss:
+    ``E * sum_e(f_e * P_e)`` with f_e the fraction of tokens routed to
+    expert e and P_e its mean router probability (minimized at uniform
+    routing, value 1.0).  ADD THIS (scaled ~1e-2) to the task loss when
+    training through :func:`moe_apply` — top-1 routing with a capacity
+    otherwise collapses onto the strongest expert and drops the rest of
+    the batch."""
+    probs = router_probs(wr, x)
+    e = probs.shape[-1]
+    assign = jnp.argmax(probs, axis=-1)
+    fraction = jnp.mean(
+        jax.nn.one_hot(assign, e, dtype=probs.dtype), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    return e * jnp.sum(fraction * mean_prob)
+
+
 def moe_reference(expert_apply, stacked_params, wr, x, capacity):
     """Single-device oracle: same top-1 routing, same capacity drops,
     experts applied in a scan."""
@@ -84,7 +101,11 @@ def moe_apply(expert_apply, stacked_params, wr, x, mesh,
     leading dim = E (sharded over the expert axis); ``wr`` [D, E]
     replicated router weights; ``x`` [B, D] (B over ``data_axis`` when
     given).  capacity = ceil(B/E * capacity_factor) tokens per expert,
-    overflow dropped exactly like the reference oracle."""
+    overflow dropped exactly like the reference oracle.
+
+    Training: include :func:`load_balance_loss` in the objective —
+    without it top-1 routing collapses and the capacity drops most of
+    the batch."""
     from jax.sharding import PartitionSpec as P
     n_experts = mesh.shape[expert_axis]
     stacked_e = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]

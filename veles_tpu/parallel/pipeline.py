@@ -48,9 +48,6 @@ def _gpipe_local(params_stage, x, *, block_apply, n_stages, microbatches,
     params_stage = jax.tree.map(lambda p: p[0], params_stage)  # [1,...]→
     m = microbatches
     b = x.shape[0]
-    if b % m:
-        raise ValueError("batch %d not divisible by %d microbatches"
-                         % (b, m))
     mb = x.reshape((m, b // m) + x.shape[1:])
     # zeros derived from x already vary over the data axis (when any);
     # only the pipe axis needs marking for the scan-carry types to agree
@@ -103,6 +100,16 @@ def gpipe_apply(block_apply, stacked_params, x, mesh, pipe_axis="pipe",
         raise ValueError("params stack %d blocks but the %r axis has %d "
                          "stages" % (stacked_s, pipe_axis, n_stages))
     m = microbatches if microbatches is not None else 2 * n_stages
+    local_b = x.shape[0] // (mesh.shape[data_axis] if data_axis else 1)
+    if local_b % m:
+        # validated HERE with the caller's numbers: inside shard_map the
+        # batch is already the data shard, which the caller never typed
+        raise ValueError(
+            "per-shard batch %d (global %d%s) not divisible by %d "
+            "microbatches"
+            % (local_b, x.shape[0],
+               " over %s=%d" % (data_axis, mesh.shape[data_axis])
+               if data_axis else "", m))
     param_spec = jax.tree.map(
         lambda _: P(pipe_axis), stacked_params)
     x_spec = P(data_axis)
