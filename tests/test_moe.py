@@ -104,7 +104,11 @@ def test_moe_rejects_expert_mismatch():
 def test_load_balance_loss_prefers_uniform_routing():
     from veles_tpu.parallel.moe import load_balance_loss
     rng = numpy.random.RandomState(7)
-    x = jnp.asarray(rng.standard_normal((64, 8)), jnp.float32)
+    # strictly positive features: the collapsed router's logit for
+    # expert 0 is 10*sum(x) > 0 for EVERY token, so routing genuinely
+    # collapses (zero-mean inputs would leave half the batch routed
+    # elsewhere and the loss near 1)
+    x = jnp.asarray(rng.uniform(0.1, 1.0, (64, 8)), jnp.float32)
     wr_uniform = jnp.zeros((8, 4), jnp.float32)   # all experts equal
     wr_collapsed = jnp.zeros((8, 4), jnp.float32).at[:, 0].set(10.0)
     near_uniform = float(load_balance_loss(wr_uniform, x))

@@ -263,14 +263,18 @@ root.update({
 
 def apply_site_config(cfg=None, paths=None):
     """Apply per-machine overrides: import ``site_config.py`` from each
-    existing path (default: $VELES_TPU_SITE_CONFIG, the XDG config dir,
-    the cwd) and call its ``update(root)``.
+    existing path (default: $VELES_TPU_SITE_CONFIG, the XDG config dir)
+    and call its ``update(root)``.
 
     The reference loaded the same hook from its dist-config dir, the
     user dir, and the cwd at import time
     (/root/reference/veles/config.py:294-308); here it is an explicit
     call (the CLI runs it before workflow-module import) so library
     users and tests control when machine-local state enters the tree.
+    The cwd is deliberately NOT searched (unlike the reference): a
+    ``site_config.py`` in an untrusted working directory would execute
+    arbitrary code on every CLI run — point $VELES_TPU_SITE_CONFIG or
+    ``paths=`` at one explicitly instead.
     Returns the list of files applied."""
     import importlib.util
     cfg = cfg if cfg is not None else root
@@ -283,7 +287,6 @@ def apply_site_config(cfg=None, paths=None):
             os.environ.get("XDG_CONFIG_HOME",
                            os.path.expanduser("~/.config")),
             "veles_tpu"))
-        paths.append(os.getcwd())
     env_explicit = os.environ.get("VELES_TPU_SITE_CONFIG")
     applied = []
     for path in paths:

@@ -108,6 +108,18 @@ class JobMaster:
             name="jobmaster-accept")
         self._accept_thread.start()
 
+    def _finish(self, job, result):
+        """Complete ``job`` exactly once (result write + done) — the
+        single completion protocol; late writers (a worker replying
+        after map() timed the job out, a drop racing a timeout) become
+        no-ops.  Returns whether THIS call completed the job."""
+        with self._lock:
+            if job.done.is_set():
+                return False
+            job.result = result
+            job.done.set()
+            return True
+
     # -- submission ----------------------------------------------------------
     def submit(self, payload):
         with self._lock:
@@ -138,11 +150,10 @@ class JobMaster:
                           file=sys.stderr)
                     last_warn = now
                 if deadline is not None and now >= deadline:
-                    job.result = {"rc": -1, "results": None,
-                                  "error": "scheduler timeout",
-                                  "worker": job.worker,
-                                  "attempts": job.attempts}
-                    job.done.set()
+                    self._finish(job, {"rc": -1, "results": None,
+                                       "error": "scheduler timeout",
+                                       "worker": job.worker,
+                                       "attempts": job.attempts})
         return [j.result for j in jobs]
 
     def close(self):
@@ -212,12 +223,15 @@ class JobMaster:
                 if msg.get("op") != "result" or msg.get("id") != job.id:
                     raise ConnectionError(
                         "protocol error from %s: %r" % (name, msg))
-                job.result = {"rc": msg.get("rc"),
-                              "results": msg.get("results"),
-                              "error": msg.get("error"),
-                              "worker": name, "attempts": job.attempts}
+                # map() may have already failed this job with a timeout
+                # result; the late worker reply must not silently
+                # overwrite what map() returned
+                self._finish(job, {"rc": msg.get("rc"),
+                                   "results": msg.get("results"),
+                                   "error": msg.get("error"),
+                                   "worker": name,
+                                   "attempts": job.attempts})
                 current = None
-                job.done.set()
             try:
                 _send(wfile, {"op": "bye"})
             except OSError:
@@ -238,13 +252,16 @@ class JobMaster:
                 pass
 
     def _requeue(self, job, reason):
+        if job.done.is_set():
+            return  # e.g. map() already timed it out — nothing to redo
         if job.attempts >= self.max_attempts:
-            job.result = {"rc": -1, "results": None,
-                          "error": "job failed after %d deliveries: %s"
-                                   % (job.attempts, reason),
-                          "worker": job.worker, "attempts": job.attempts}
-            job.done.set()
-            if not self.silent:
+            if self._finish(job, {"rc": -1, "results": None,
+                                  "error": "job failed after %d "
+                                           "deliveries: %s"
+                                           % (job.attempts, reason),
+                                  "worker": job.worker,
+                                  "attempts": job.attempts}) \
+                    and not self.silent:
                 print("jobmaster: dropping job %d (%s)"
                       % (job.id, reason), file=sys.stderr)
         else:

@@ -18,7 +18,8 @@ device gather.  The capability surface kept from the reference:
   (image.py:138,254-280);
 - mirror: False | True (expand the train set with flipped copies) |
   "random" (seeded per-sample coin flip, the static-dataset equivalent
-  of the reference's per-epoch random mirror) (image.py:283-291);
+  of the reference's per-epoch random mirror); both TRAIN only
+  (image.py:283-291);
 - grayscale / color_space conversions (RGB, L/GRAY, HSV, YCbCr — PIL
   modes; reference used OpenCV spaces, image.py:116-127);
 - ``add_sobel`` extra edge-magnitude channel (image.py:131,384,433);
@@ -241,15 +242,17 @@ class ImageTransformer:
         return samples, counts
 
     def apply_mirror(self, cls, samples, labels, paired=None):
-        """mirror=True: append flipped copies (TRAIN only — flipped eval
-        samples would distort validation metrics); mirror="random":
-        seeded per-sample coin flip in place."""
+        """mirror=True: append flipped copies; mirror="random": seeded
+        per-sample coin flip in place.  Both modes are TRAIN only —
+        flipped eval samples would distort validation metrics."""
         if self.mirror is True and cls == TRAIN:
             samples += [s[:, ::-1].copy() for s in samples]
             labels += list(labels)
             if paired is not None:
                 paired += [t[:, ::-1].copy() for t in paired]
-        elif self.mirror == "random":
+        elif self.mirror == "random" and cls == TRAIN:
+            # TRAIN-only for the same reason as mirror=True: randomly
+            # flipped eval samples would distort validation metrics
             for i in range(len(samples)):
                 if self.prng.randint(0, 2):
                     samples[i] = samples[i][:, ::-1].copy()
