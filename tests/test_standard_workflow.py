@@ -241,13 +241,15 @@ def test_fused_softmax_output_is_probabilities():
 
 
 def test_mnist_sample_converges():
-    """MnistSimple (synthetic twin dataset) must beat the 1.48% baseline
-    analog comfortably."""
+    """MnistSimple on the committed digits fixture (round 4: the loader
+    prefers the real IDX fixture over the synthetic twin, which is
+    harder at this 1500-sample subset — hence more epochs than the
+    old synthetic smoke test)."""
     from veles_tpu.znicz.samples import mnist
     wf = mnist.create_workflow(
         loader={"minibatch_size": 60, "n_train": 1500, "n_valid": 400,
                 "prng": RandomGenerator().seed(3)},
-        decision={"max_epochs": 6, "silent": True})
+        decision={"max_epochs": 14, "silent": True})
     wf.initialize(device=Device(backend="cpu"))
     wf.run()
     assert wf.is_finished
@@ -262,7 +264,7 @@ def test_bf16_mixed_precision_trains():
     wf = mnist.create_workflow(
         loader={"minibatch_size": 100, "n_train": 1000, "n_valid": 300,
                 "prng": RandomGenerator().seed(3)},
-        decision={"max_epochs": 3, "silent": True},
+        decision={"max_epochs": 8, "silent": True},
         trainer={"compute_dtype": "bfloat16"})
     wf.initialize(device=Device(backend="auto"))
     wf.run()

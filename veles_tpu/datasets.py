@@ -36,7 +36,7 @@ def _read_idx(path):
                  0x0C: numpy.int32, 0x0D: numpy.float32,
                  0x0E: numpy.float64}[(magic >> 8) & 0xFF]
         shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
-        data = numpy.frombuffer(f.read(), dtype.newbyteorder(">"))
+        data = numpy.frombuffer(f.read(), numpy.dtype(dtype).newbyteorder(">"))
         return data.reshape(shape).astype(dtype)
 
 
@@ -80,18 +80,60 @@ def load_mnist(n_train=None, n_valid=None):
     """(train_images, train_labels), (valid_images, valid_labels) as uint8
     arrays; real MNIST when the IDX files exist, synthetic otherwise.
     Returns (train, valid, is_real)."""
-    d = os.path.join(_dataset_dir(), "mnist")
-    names = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
-             "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+    train, valid, provenance = load_digits_idx(n_train, n_valid,
+                                               fixture=False)
+    return train, valid, provenance == "real"
+
+
+def fixture_dir():
+    """The committed IDX digits fixture, shipped INSIDE the package
+    (``veles_tpu/fixtures/digits``) so installed copies and pruned
+    checkouts keep the real-file tier; override with
+    $VELES_TPU_FIXTURES."""
+    env = os.environ.get("VELES_TPU_FIXTURES")
+    return env or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "fixtures",
+        "digits")
+
+
+_IDX_NAMES = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+              "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+
+
+def _find_idx(d):
     paths = []
-    for n in names:
+    for n in _IDX_NAMES:
         for cand in (os.path.join(d, n), os.path.join(d, n + ".gz")):
             if os.path.exists(cand):
                 paths.append(cand)
                 break
-    if len(paths) == 4:
-        ti, tl, vi, vl = (_read_idx(p) for p in paths)
-        return ((ti[:n_train], tl[:n_train].astype(numpy.int32)),
-                (vi[:n_valid], vl[:n_valid].astype(numpy.int32)), True)
+    return paths if len(paths) == 4 else None
+
+
+def load_digits_idx(n_train=None, n_valid=None, fixture=True):
+    """The three-tier digits source, in provenance order:
+
+    1. ``"real"`` — true MNIST IDX files under
+       ``root.common.dirs.datasets/mnist`` (drop them there on any host
+       with egress; format per http://yann.lecun.com/exdb/mnist/);
+    2. ``"fixture"`` — the committed font-rendered IDX archives under
+       ``veles_tpu/fixtures/digits`` (tools/make_digits_fixture.py): REAL
+       fixed files exercising the identical gz-IDX parse + loader path,
+       vendored because this build environment has zero egress;
+    3. ``"synthetic"`` — :func:`synthetic_mnist`, generated in-process.
+
+    Returns ((train_images, train_labels), (valid_images, valid_labels),
+    provenance_str).  ``fixture=False`` skips tier 2 (used by
+    :func:`load_mnist`, whose contract is real-or-synthetic)."""
+    tiers = [(os.path.join(_dataset_dir(), "mnist"), "real")]
+    if fixture:
+        tiers.append((fixture_dir(), "fixture"))
+    for d, provenance in tiers:
+        paths = _find_idx(d)
+        if paths:
+            ti, tl, vi, vl = (_read_idx(p) for p in paths)
+            return ((ti[:n_train], tl[:n_train].astype(numpy.int32)),
+                    (vi[:n_valid], vl[:n_valid].astype(numpy.int32)),
+                    provenance)
     train, valid = synthetic_mnist(n_train or 6000, n_valid or 1000)
-    return train, valid, False
+    return train, valid, "synthetic"

@@ -16,7 +16,7 @@ import numpy
 from ...config import root
 from ...loader.fullbatch import FullBatchLoaderMSE
 from ...loader.base import TEST, VALID, TRAIN
-from ...datasets import load_mnist
+from ...datasets import load_digits_idx
 from ..standard_workflow import StandardWorkflow
 
 root.mnist_ae.update({
@@ -45,11 +45,13 @@ class MnistAELoader(FullBatchLoaderMSE):
     def __init__(self, workflow, **kwargs):
         self.n_train = kwargs.pop("n_train", None)
         self.n_valid = kwargs.pop("n_valid", None)
+        self.use_fixture = kwargs.pop("use_fixture", True)
         super().__init__(workflow, **kwargs)
 
     def load_data(self):
-        (ti, tl), (vi, vl), self.is_real = load_mnist(
-            self.n_train, self.n_valid)
+        (ti, tl), (vi, vl), self.provenance = load_digits_idx(
+            self.n_train, self.n_valid, fixture=self.use_fixture)
+        self.is_real = self.provenance == "real"
         data = numpy.concatenate([vi, ti]).astype(numpy.float32)
         data = data.reshape(len(data), -1)
         self.original_data.mem = data
