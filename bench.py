@@ -26,8 +26,14 @@ Also reported in the same JSON line:
   used instead — the bench never silently drops its key diagnostic.
 - ``bf16_speedup_vs_f32`` — the mixed-precision gain on the scan path.
 - ``pallas_lrn_speedup`` — epoch-scan throughput with the Pallas LRN
-  kernel pair enabled vs the jnp formula (records the hand-kernel delta
-  on the real chip once per round).
+  kernel pair enabled vs the default MXU banded-matmul formula (records
+  the hand-kernel delta on the real chip once per round; round-4
+  measurement: the gridded kernel compiles in ~18 s but the pallas_call
+  boundary blocks XLA fusion, so the pure-XLA MXU path stays default).
+- ``precise_gemm`` — on-chip cost of the compensated GEMM levels
+  ({l0_tflops, l1_overhead, l2_overhead, l0_vs_xla_default}); the
+  reference charged +9 %/+90 % for levels 1/2, on the MXU the block
+  compensation is ~free (round-4 measurement: 0.99x/1.01x).
 - ``mnist_anchor_images_per_sec`` + ``mnist_vs_anchor`` — the round-1
   MNIST-FC epoch-scan anchor (1.127M img/s, the value the DRIVER
   recorded in BENCH_r01.json), kept as a regression canary for the
@@ -235,47 +241,113 @@ def bench_mnist(batch=512, epochs=24, n_train=16384, repeats=10):
     return n_train * epochs / _record("mnist", times)
 
 
-def _pallas_lrn_subprocess(timeout=600):
-    """The Pallas-LRN stage in a KILLABLE subprocess: Mosaic compiles
-    through the tunneled (axon) remote-compile service can exceed 20
-    minutes or wedge outright — measured once per round, but never
-    allowed to take the whole bench down (VERDICT r2 item 10)."""
+def _stage_subprocess(stage, key, timeout=600):
+    """A bench stage in a KILLABLE subprocess: Mosaic compiles through
+    the tunneled (axon) remote-compile service historically wedged
+    (fixed in round 4 by gridding the kernels — both now compile in
+    ~15 s — but the isolation stays: one bad kernel must never take the
+    whole bench down; VERDICT r2 item 10).  Returns (payload, error)."""
     import subprocess
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
-             "--stage", "pallas_lrn"],
+             "--stage", stage],
             capture_output=True, timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
-        return None, "timeout after %ds (Mosaic remote compile)" % timeout
+        return None, "stage %s timeout after %ds" % (stage, timeout)
     if proc.returncode:
         return None, "exit %d: %s" % (proc.returncode,
                                       proc.stderr.decode()[-500:])
     try:
         line = json.loads(proc.stdout.decode().strip().splitlines()[-1])
-        return float(line["pallas_lrn_images_per_sec"]), None
+        return line[key], None
     except (ValueError, KeyError, IndexError) as exc:
         return None, "bad stage output: %r" % exc
+
+
+def bench_precise_gemm(n=4096, reps=8, repeats=6):
+    """On-chip overhead of the compensated GEMM levels (znicz/gemm.py)
+    vs its own level-0 blocking and vs XLA's stock matmul — the TPU
+    answer to the reference's published +9 % / +90 % level-1/2 cost
+    (/root/reference/veles/config.py:245-248).  ``reps`` chained matmuls
+    ride one dispatch (data dependency) so the ~14 ms tunnel RTT
+    amortizes; the D2H read of one element is the only reliable flush
+    on axon."""
+    import numpy
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu.znicz.gemm import precise_matmul
+    _stamp("precise-gemm stage")
+    rng = numpy.random.RandomState(0)
+    a = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+
+    def chain(f):
+        def g(a, b):
+            y = f(a, b)
+            for _ in range(reps - 1):
+                y = f(a, y / jnp.float32(n))
+            return y
+        return jax.jit(g)
+
+    fns = {"xla_default": lambda a, b: jnp.dot(a, b)}
+    for lvl in (0, 1, 2):
+        fns["level%d" % lvl] = \
+            lambda a, b, l=lvl: precise_matmul(a, b, l, False)
+    res = {}
+    for name, f in fns.items():
+        g = chain(f)
+        y = g(a, b)
+        numpy.asarray(y[0, 0])  # compile + flush
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            y = g(a, b)
+            numpy.asarray(y[0, 0])
+            times.append((time.perf_counter() - t0) / reps)
+        _record("gemm_" + name, times)
+        # ratios use the MEDIAN: on the shared chip a freak-fast or
+        # freak-slow min would make overhead ratios meaningless
+        res[name] = statistics.median(times)
+    return {
+        "l0_tflops": round(2 * n ** 3 / res["level0"] / 1e12, 2),
+        "l1_overhead": round(res["level1"] / res["level0"], 3),
+        "l2_overhead": round(res["level2"] / res["level0"], 3),
+        "l0_vs_xla_default": round(res["level0"] / res["xla_default"],
+                                   3),
+    }
 
 
 if __name__ == "__main__":
     BATCH = 128  # shared by every AlexNet bench below and the MFU math
     if "--stage" in sys.argv:  # subprocess entry: one isolated stage
         stage = sys.argv[sys.argv.index("--stage") + 1]
-        assert stage == "pallas_lrn", stage
-        ips = bench_alexnet_scan(batch=BATCH, use_pallas_lrn=True,
-                                 repeats=3, name="alexnet_pallas_lrn")
-        print(json.dumps({"pallas_lrn_images_per_sec": round(ips, 1),
-                          "spread": SPREAD}))
+        if stage == "pallas_lrn":
+            ips = bench_alexnet_scan(batch=BATCH, use_pallas_lrn=True,
+                                     repeats=3, name="alexnet_pallas_lrn")
+            print(json.dumps({"pallas_lrn_images_per_sec": round(ips, 1),
+                              "spread": SPREAD}))
+        elif stage == "precise_gemm":
+            print(json.dumps({"precise_gemm": bench_precise_gemm(),
+                              "spread": SPREAD}))
+        else:
+            raise SystemExit("unknown stage %r" % stage)
         sys.exit(0)
-    # pallas-LRN subprocess FIRST: on a directly-attached TPU, libtpu is
-    # single-process, so the child must own the chip before this process
-    # initializes JAX (every bench call below does)
+    # Pallas subprocess stages FIRST: on a directly-attached TPU, libtpu
+    # is single-process, so the children must own the chip before this
+    # process initializes JAX (every bench call below does)
     _stamp("pallas-LRN stage (isolated subprocess)")
-    lrn_ips, lrn_error = _pallas_lrn_subprocess()
+    lrn_ips, lrn_error = _stage_subprocess(
+        "pallas_lrn", "pallas_lrn_images_per_sec")
     if lrn_error:
         print("bench: pallas-LRN run failed: %s" % lrn_error,
+              file=sys.stderr)
+    _stamp("precise-gemm stage (isolated subprocess)")
+    gemm_res, gemm_error = _stage_subprocess(
+        "precise_gemm", "precise_gemm")
+    if gemm_error:
+        print("bench: precise-gemm run failed: %s" % gemm_error,
               file=sys.stderr)
     scan_ips = bench_alexnet_scan(batch=BATCH)
     bf16_ips = bench_alexnet_scan(batch=BATCH, compute_dtype="bfloat16",
@@ -308,8 +380,12 @@ if __name__ == "__main__":
         "spread": SPREAD,
     }
     if lrn_ips is not None:
-        line["pallas_lrn_images_per_sec"] = round(lrn_ips, 1)
-        line["pallas_lrn_speedup"] = round(lrn_ips / scan_ips, 3)
+        line["pallas_lrn_images_per_sec"] = round(float(lrn_ips), 1)
+        line["pallas_lrn_speedup"] = round(float(lrn_ips) / scan_ips, 3)
     else:
         line["pallas_lrn_error"] = lrn_error
+    if gemm_res is not None:
+        line["precise_gemm"] = gemm_res
+    else:
+        line["precise_gemm_error"] = gemm_error
     print(json.dumps(line))

@@ -1,6 +1,6 @@
 """init_multihost over two REAL processes (VERDICT round-2 item 4):
-a coordinator + 2 CPU processes form one 4-device mesh, run one fused
-sharded train step, and must end with identical params on both hosts
+a coordinator + 2 CPU processes form one 4-device mesh, run sharded
+training, and must end with identical params on both hosts
 (the reference tested its whole network stack in-process the same way,
 /root/reference/veles/tests/test_network.py:52-116)."""
 
@@ -20,15 +20,17 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_mesh_trains_identically(tmp_path):
+def _run_children(tmp_path, mode):
+    """Launch the 2-process cluster (_multihost_child.py) in ``mode``
+    and return both ranks' saved first-layer weights."""
     port = _free_port()
-    outs = [str(tmp_path / ("w%d.npy" % r)) for r in (0, 1)]
+    outs = [str(tmp_path / ("%s%d.npy" % (mode, r))) for r in (0, 1)]
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # the child sets its own device count
     env["JAX_PLATFORMS"] = "cpu"
     procs = [subprocess.Popen(
         [sys.executable, os.path.join(HERE, "_multihost_child.py"),
-         str(r), str(port), outs[r]],
+         str(r), str(port), outs[r], mode],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for r in (0, 1)]
     logs = []
@@ -42,8 +44,44 @@ def test_two_process_mesh_trains_identically(tmp_path):
         logs.append(out.decode())
     for p, log in zip(procs, logs):
         assert p.returncode == 0, log[-3000:]
-    w0, w1 = numpy.load(outs[0]), numpy.load(outs[1])
+    return numpy.load(outs[0]), numpy.load(outs[1])
+
+
+def test_two_process_mesh_trains_identically(tmp_path):
+    w0, w1 = _run_children(tmp_path, "step")
     assert w0.shape == w1.shape
     assert numpy.array_equal(w0, w1), "hosts diverged after one step"
     # the step actually trained (weights moved off the deterministic init)
     assert numpy.abs(w0).sum() > 0
+
+
+def test_two_process_epoch_scan_matches_single_process(tmp_path):
+    """The multi-host epoch-scan (VERDICT round-3 item 4): 2 processes x
+    2 CPU devices run DistributedScanStep.train_epochs(2) over one
+    dp=4 mesh; both hosts must agree with each other AND with the same
+    scan run in ONE process on a local dp=4 mesh."""
+    w0, w1 = _run_children(tmp_path, "scan")
+    assert numpy.array_equal(w0, w1), "hosts diverged after scan"
+
+    # single-process oracle on this process's own 4-device dp mesh
+    from veles_tpu import prng
+    from veles_tpu.backends import Device
+    from veles_tpu.parallel.mesh import make_mesh
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.znicz.samples import mnist
+    import jax
+    # weight init draws from the GLOBAL generator: reseed to the fresh-
+    # process default so the oracle matches the children regardless of
+    # which suite tests consumed global draws before this one
+    prng.get().seed(42)
+    mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    wf = mnist.create_workflow(
+        loader={"minibatch_size": 16, "n_train": 64, "n_valid": 16,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 1, "silent": True},
+        mesh=mesh, epoch_scan=True)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.fused_step.train_epochs(2)
+    w_ref = numpy.asarray(wf.fused_step._params_[0]["weights"])
+    assert numpy.allclose(w0, w_ref, atol=2e-5), \
+        numpy.abs(w0 - w_ref).max()

@@ -1,20 +1,32 @@
-"""Expert parallelism: switch-style top-1 mixture-of-experts over a
-mesh axis.
+"""Expert parallelism: mixture-of-experts over a mesh axis.
 
 The last of the mesh quintet (data/tensor/pipeline/sequence/expert):
 E experts' parameters shard over the ``expert`` axis — each device owns
 ONE expert and computes only the tokens routed to it (bounded by a
 capacity), so expert compute scales with the axis instead of
-replicating.  Routing is switch-transformer top-1: a linear router,
-softmax gate, tokens over capacity dropped (the standard trade;
-capacity_factor sizes the buffer).  The combine is a masked ``psum`` —
-every token's result lives on exactly one expert shard.
+replicating.  Routing is a linear router + softmax gate with tokens
+over capacity dropped (the standard trade; capacity_factor sizes the
+buffer).  Two routing depths and two dispatch layouts:
 
-Tokens (x) are replicated over the expert axis (and split over ``data``
-when composed dp x ep); an ``all_to_all`` dispatch variant for
-token-sharded inputs is the scale-up path once token counts outgrow
-replication.  Autodiff flows through routing (straight-through on the
-gate probability), so the layer trains end-to-end
+- top-1 (switch-transformer) or top-k (GShard style, ``k=2`` default
+  for ``moe_apply(..., k=2)``): the k chosen gates renormalize to sum
+  to 1; choice 1 fills capacity before choice 2 (the standard
+  priority), so a second choice never evicts a first.
+- replicated dispatch (:func:`moe_apply`): tokens live on every expert
+  shard, the combine is a masked ``psum``.  Simple, right for models
+  whose batch fits every device.
+- token-sharded dispatch (:func:`moe_apply_a2a`): tokens are SHARDED
+  over the expert axis (dp x ep: the expert axis doubles as a data
+  axis), packed per destination expert into fixed-capacity buffers and
+  exchanged with ``lax.all_to_all`` over ICI, expert compute runs on
+  its own shard only, and a second all_to_all carries results home.
+  Per-device input bandwidth now scales with E — this is the scale-up
+  path the replicated layout cannot reach (VERDICT round-3 item 5).
+  Capacity is per (source shard, expert): C = ceil(k * B_local * cf / E).
+
+Autodiff flows through routing (straight-through on the gate
+probability) and through both all_to_alls (their transpose is the
+reverse all_to_all), so both layers train end-to-end
 (tests/test_moe.py)."""
 
 import functools
@@ -46,67 +58,85 @@ def load_balance_loss(wr, x):
     return e * jnp.sum(fraction * mean_prob)
 
 
-def moe_reference(expert_apply, stacked_params, wr, x, capacity):
-    """Single-device oracle: same top-1 routing, same capacity drops,
-    experts applied in a scan."""
+def _topk_routing(probs, k):
+    """(dsts[B, k], gates[B, k]) — top-k experts per token, gates
+    renormalized over the chosen k (for k=1 the gate is the raw top
+    probability, the switch-transformer convention)."""
+    topv, topi = lax.top_k(probs, k)
+    if k == 1:
+        return topi, topv
+    return topi, topv / jnp.sum(topv, axis=-1, keepdims=True)
+
+
+def _choice_major_slots(dsts, n_experts):
+    """Capacity queue positions, choice-major: ALL first choices (in
+    batch order) fill an expert's slots before any second choice — a
+    2nd pick never evicts a 1st (the GShard priority).  ``dsts`` is
+    [B, k]; returns pos[B, k] (the token's slot in its expert's
+    queue)."""
+    b, k = dsts.shape
+    flat = dsts.transpose(1, 0).reshape(-1)           # choice-major
+    onehot = jax.nn.one_hot(flat, n_experts, dtype=jnp.int32)
+    pos_flat = jnp.cumsum(onehot, axis=0) - 1
+    pos_flat = jnp.take_along_axis(pos_flat, flat[:, None],
+                                   axis=1)[:, 0]
+    return pos_flat.reshape(k, b).transpose(1, 0)
+
+
+def moe_reference(expert_apply, stacked_params, wr, x, capacity, k=1):
+    """Single-device oracle: same top-k routing, same choice-major
+    capacity drops, experts applied in a scan."""
     e = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     probs = router_probs(wr, x)
-    assign = jnp.argmax(probs, axis=-1)
-    gate = jnp.take_along_axis(probs, assign[:, None], axis=1)[:, 0]
+    dsts, gates = _topk_routing(probs, k)
+    pos = _choice_major_slots(dsts, e)
+    keep = pos < capacity
     out = jnp.zeros_like(expert_apply(
         jax.tree.map(lambda p: p[0], stacked_params), x))
 
     def per_expert(out, i):
-        params_i = jax.tree.map(lambda p: p[i], stacked_params)
-        mine = assign == i
-        pos = jnp.cumsum(mine) - 1
-        keep = jnp.logical_and(mine, pos < capacity)
+        params_i = jax.tree_util.tree_map(lambda p: p[i], stacked_params)
         y = expert_apply(params_i, x)
-        return out + jnp.where(keep[:, None], y, 0.0), None
+        w = jnp.sum(jnp.where(jnp.logical_and(dsts == i, keep),
+                              gates, 0.0), axis=1)
+        return out + y * w[:, None], None
 
     out, _ = lax.scan(per_expert, out, jnp.arange(e))
-    return out * gate[:, None]
+    return out
 
 
 def _moe_local(stacked_params, wr, x, *, expert_apply, capacity,
-               axis_name):
+               axis_name, k):
     e_idx = lax.axis_index(axis_name)
     params_e = jax.tree.map(lambda p: p[0], stacked_params)
     b, d = x.shape
     probs = router_probs(wr, x)
-    assign = jnp.argmax(probs, axis=-1)
-    gate = jnp.take_along_axis(probs, assign[:, None], axis=1)[:, 0]
-    mine = assign == e_idx
-    pos = jnp.cumsum(mine) - 1                  # queue slot per token
-    keep = jnp.logical_and(mine, pos < capacity)
-    # pack this expert's tokens into a [capacity, D] buffer (one extra
-    # trash row absorbs everything dropped or foreign)
-    slot = jnp.where(keep, pos, capacity)
-    buf = jnp.zeros((capacity + 1, d), x.dtype).at[slot].set(x)
+    n_experts = probs.shape[-1]
+    dsts, gates = _topk_routing(probs, k)
+    pos = _choice_major_slots(dsts, n_experts)
+    keep = pos < capacity
+    # this shard's view: which (token, choice) pairs point at me
+    mine = jnp.logical_and(dsts == e_idx, keep)
+    # pack my tokens into a [capacity, D] buffer (one extra trash row
+    # absorbs everything dropped or foreign); a token picking me in any
+    # choice lands in its queue slot
+    slot = jnp.where(mine, pos, capacity)      # [b, k]
+    buf = jnp.zeros((capacity + 1, d), x.dtype)
+    for j in range(k):
+        buf = buf.at[slot[:, j]].set(x)
     y = expert_apply(params_e, buf[:capacity])
-    # unpack: token i reads its slot's row; non-kept tokens contribute 0
-    out = jnp.where(keep[:, None],
-                    y[jnp.clip(pos, 0, capacity - 1)], 0.0)
-    out = out * gate[:, None]
-    # each token was computed on exactly one expert shard
+    # unpack: each (token, choice) routed here reads its slot's row,
+    # weighted by its renormalized gate
+    out = 0.0
+    for j in range(k):
+        row = y[jnp.clip(pos[:, j], 0, capacity - 1)]
+        out = out + jnp.where(mine[:, j, None],
+                              row * gates[:, j, None], 0.0)
+    # every (token, choice) was computed on exactly one expert shard
     return lax.psum(out, axis_name)
 
 
-def moe_apply(expert_apply, stacked_params, wr, x, mesh,
-              expert_axis="expert", data_axis=None,
-              capacity_factor=1.25):
-    """Expert-parallel top-1 MoE over ``mesh[expert_axis]``.
-
-    expert_apply(params_i, h[B, D]) -> [B, D']; ``stacked_params``
-    leading dim = E (sharded over the expert axis); ``wr`` [D, E]
-    replicated router weights; ``x`` [B, D] (B over ``data_axis`` when
-    given).  capacity = ceil(B/E * capacity_factor) tokens per expert,
-    overflow dropped exactly like the reference oracle.
-
-    Training: include :func:`load_balance_loss` in the objective —
-    without it top-1 routing collapses and the capacity drops most of
-    the batch."""
-    from jax.sharding import PartitionSpec as P
+def _check_expert_counts(mesh, expert_axis, stacked_params, wr):
     n_experts = mesh.shape[expert_axis]
     stacked_e = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     if stacked_e != n_experts or wr.shape[1] != n_experts:
@@ -115,18 +145,130 @@ def moe_apply(expert_apply, stacked_params, wr, x, mesh,
         raise ValueError(
             "expert count mismatch: params stack %d, router %d, mesh "
             "axis %d" % (stacked_e, wr.shape[1], n_experts))
+    return n_experts
+
+
+def moe_apply(expert_apply, stacked_params, wr, x, mesh,
+              expert_axis="expert", data_axis=None,
+              capacity_factor=1.25, k=1):
+    """Expert-parallel top-k MoE over ``mesh[expert_axis]``, replicated
+    token layout.
+
+    expert_apply(params_i, h[B, D]) -> [B, D']; ``stacked_params``
+    leading dim = E (sharded over the expert axis); ``wr`` [D, E]
+    replicated router weights; ``x`` [B, D] (B over ``data_axis`` when
+    given).  capacity = ceil(k * B/E * capacity_factor) tokens per
+    expert,
+    overflow dropped exactly like the reference oracle (choice-major
+    for k > 1).
+
+    Training: include :func:`load_balance_loss` in the objective —
+    without it top-1 routing collapses and the capacity drops most of
+    the batch."""
+    from jax.sharding import PartitionSpec as P
+    n_experts = _check_expert_counts(mesh, expert_axis, stacked_params,
+                                     wr)
     local_b = x.shape[0] // (mesh.shape[data_axis] if data_axis else 1)
-    capacity = moe_capacity(local_b, n_experts, capacity_factor)
+    capacity = moe_capacity(local_b, n_experts, capacity_factor, k)
     param_spec = jax.tree.map(lambda _: P(expert_axis), stacked_params)
     fn = jax.shard_map(
         functools.partial(_moe_local, expert_apply=expert_apply,
-                          capacity=capacity, axis_name=expert_axis),
+                          capacity=capacity, axis_name=expert_axis,
+                          k=k),
         mesh=mesh,
         in_specs=(param_spec, P(), P(data_axis)),
         out_specs=P(data_axis))
     return fn(stacked_params, wr, x)
 
 
-def moe_capacity(batch, n_experts, capacity_factor=1.25):
-    """The per-expert token budget moe_apply uses (for tests/sizing)."""
-    return max(1, int(-(-batch * capacity_factor // n_experts)))
+def _moe_a2a_local(stacked_params, wr, x, *, expert_apply, capacity,
+                   axis_name, k):
+    """Token-sharded dispatch: ``x`` here is THIS device's B/E tokens.
+
+    pack -> all_to_all -> expert -> all_to_all back -> combine; see the
+    module docstring.  Capacity is per (source shard, destination
+    expert), so the exchanged buffers are static [E, capacity, D]."""
+    params_e = jax.tree.map(lambda p: p[0], stacked_params)
+    bl, d = x.shape
+    probs = router_probs(wr, x)
+    n_experts = probs.shape[-1]
+    dsts, gates = _topk_routing(probs, k)
+    pos = _choice_major_slots(dsts, n_experts)          # [bl, k]
+    keep = pos < capacity
+    # pack: one [E, capacity, D] buffer of my tokens by destination
+    # (+1 trash row per expert absorbs drops)
+    buf = jnp.zeros((n_experts, capacity + 1, d), x.dtype)
+    for j in range(k):
+        slot = jnp.where(keep[:, j], pos[:, j], capacity)
+        buf = buf.at[dsts[:, j], slot].set(x)
+    # exchange: received[src] = the buffer shard src packed for me
+    received = lax.all_to_all(buf[:, :capacity], axis_name, 0, 0,
+                              tiled=True)               # [E, cap, D]
+    y = expert_apply(params_e, received.reshape(n_experts * capacity, d))
+    y = y.reshape(n_experts, capacity, -1)
+    # return results to their source shards
+    back = lax.all_to_all(y, axis_name, 0, 0, tiled=True)
+    # combine: each (token, choice) reads its slot from its expert's
+    # returned buffer, weighted by the renormalized gate
+    out = 0.0
+    for j in range(k):
+        row = back[dsts[:, j], jnp.clip(pos[:, j], 0, capacity - 1)]
+        out = out + jnp.where(keep[:, j, None],
+                              row * gates[:, j, None], 0.0)
+    return out
+
+
+def moe_apply_a2a(expert_apply, stacked_params, wr, x, mesh,
+                  expert_axis="expert", data_axis=None,
+                  capacity_factor=1.25, k=1):
+    """Expert-parallel top-k MoE with token-sharded all_to_all dispatch.
+
+    Same contract as :func:`moe_apply` except tokens are SHARDED, not
+    replicated: ``x``'s batch splits over ``(data_axis, expert_axis)``
+    (or just the expert axis), each device routes only its B/(D*E)
+    tokens, and the dispatch/combine ride two ``all_to_all`` collectives
+    over ICI.  Per-device input bandwidth scales with the axis size —
+    use this once token counts outgrow replication.  Capacity (and so
+    the drop rule) is per (source shard, expert):
+    ``ceil(k * B_local * capacity_factor / E)`` — vs the replicated path's
+    single global queue; :func:`moe_a2a_reference` is the matching
+    oracle."""
+    from jax.sharding import PartitionSpec as P
+    n_experts = _check_expert_counts(mesh, expert_axis, stacked_params,
+                                     wr)
+    shards = n_experts * (mesh.shape[data_axis] if data_axis else 1)
+    if x.shape[0] % shards:
+        raise ValueError("batch %d not divisible by %d token shards"
+                         % (x.shape[0], shards))
+    local_b = x.shape[0] // shards
+    capacity = moe_capacity(local_b, n_experts, capacity_factor, k)
+    param_spec = jax.tree.map(lambda _: P(expert_axis), stacked_params)
+    batch_axes = (data_axis, expert_axis) if data_axis else expert_axis
+    fn = jax.shard_map(
+        functools.partial(_moe_a2a_local, expert_apply=expert_apply,
+                          capacity=capacity, axis_name=expert_axis,
+                          k=k),
+        mesh=mesh,
+        in_specs=(param_spec, P(), P(batch_axes)),
+        out_specs=P(batch_axes))
+    return fn(stacked_params, wr, x)
+
+
+def moe_a2a_reference(expert_apply, stacked_params, wr, x, n_shards,
+                      capacity, k=1):
+    """Single-device oracle for :func:`moe_apply_a2a`: the batch is
+    split into ``n_shards`` source shards, each with its own per-expert
+    choice-major capacity queue."""
+    parts = jnp.split(x, n_shards)
+    return jnp.concatenate([
+        moe_reference(expert_apply, stacked_params, wr, part, capacity,
+                      k=k)
+        for part in parts])
+
+
+def moe_capacity(batch, n_experts, capacity_factor=1.25, k=1):
+    """The per-expert token budget moe_apply uses (for tests/sizing):
+    ``ceil(k * batch * capacity_factor / n_experts)`` — scaled by the
+    routing depth (k * batch (token, choice) pairs compete for slots;
+    the GShard sizing)."""
+    return max(1, int(-(-k * batch * capacity_factor // n_experts)))

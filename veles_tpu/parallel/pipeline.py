@@ -1,4 +1,4 @@
-"""Pipeline parallelism: GPipe-style microbatched stages over a mesh axis.
+"""Pipeline parallelism: microbatched stages over a mesh axis.
 
 Beyond the reference's master–slave data parallelism, but part of the
 platform's "scale past one device" contract: a stack of IDENTICAL
@@ -6,18 +6,33 @@ blocks (the transformer/MLP regime — SPMD requires every device to run
 the same program, so heterogeneous stages are out of scope and
 documented as such) is split over the ``pipe`` mesh axis, the batch is
 split into microbatches, and activations flow stage→stage over ICI via
-``ppermute`` in a ``lax.scan`` over pipeline ticks.  The classic GPipe
-schedule: M microbatches drain through S stages in M + S - 1 ticks,
-bubble fraction (S-1)/(M+S-1).
+``ppermute`` in a ``lax.scan`` over pipeline ticks.
 
-Because the schedule is expressed as a scan of ppermutes, ``jax.grad``
-differentiates straight through it — the reverse pipeline (activation
-grads flowing backwards over the ring) falls out of autodiff rather
-than being hand-scheduled, and parity with the sequential stack is
-exact (asserted in tests/test_pipeline.py, values AND gradients).
+Two schedules:
 
-Composes with the ``data`` axis (dp x pp meshes): batch on ``data``,
-stages on ``pipe``.
+- :func:`gpipe_apply` — the classic GPipe schedule: M microbatches
+  drain through S stages in M + S - 1 ticks, bubble fraction
+  (S-1)/(M+S-1).  Because the schedule is expressed as a scan of
+  ppermutes, ``jax.grad`` differentiates straight through it — the
+  reverse pipeline falls out of autodiff — at the cost of autodiff
+  stashing residuals for EVERY tick: activation memory grows O(M).
+- :func:`gpipe_train_1f1b` — the 1F1B (PipeDream-flush) schedule,
+  hand-scheduled forward AND backward in ONE interleaved scan: stage
+  ``i`` runs the forward of microbatch j at tick i + j and its
+  backward at tick 2(S-1) - i + j, so a microbatch's backward starts
+  as soon as its forward drains — a stage holds at most 2(S-1-i)+1
+  stashed block inputs (a circular O(S) buffer, **independent of M**)
+  and recomputes the block under ``jax.vjp`` at backward ticks
+  (rematerialization).  The trade, measured on the 8-device CPU mesh
+  (tests/test_pipeline.py): wall-clock M + 2(S-1) ticks each costing
+  fwd+bwd (vs GPipe's 2(M+S-1) ticks costing one of them) — i.e. an
+  extra (S-1) op-slots of bubble — in exchange for O(S) instead of
+  O(M) activation memory.  Use it when long microbatch trains blow
+  HBM; use GPipe when M is small.
+
+Both compose with the ``data`` axis (dp x pp meshes): batch on
+``data``, stages on ``pipe``.  Parity with the sequential stack is
+exact for values AND gradients (tests/test_pipeline.py).
 """
 
 import functools
@@ -118,4 +133,123 @@ def gpipe_apply(block_apply, stacked_params, x, mesh, pipe_axis="pipe",
                           n_stages=n_stages, microbatches=m,
                           axis_name=pipe_axis),
         mesh=mesh, in_specs=(param_spec, x_spec), out_specs=x_spec)
+    return fn(stacked_params, x)
+
+
+def _1f1b_local(params_stage, x, *, block_apply, out_grad, n_stages,
+                microbatches, axis_name):
+    """Per-device 1F1B: fwd of mb j at tick idx + j, bwd of mb j at tick
+    2(S-1) - idx + j; block inputs stash in a circular O(S) buffer and
+    the block is recomputed under jax.vjp at backward ticks."""
+    idx = lax.axis_index(axis_name)
+    params_stage = jax.tree.map(lambda p: p[0], params_stage)
+    s, m = n_stages, microbatches
+    b = x.shape[0]
+    mb = x.reshape((m, b // m) + x.shape[1:])
+    cap = min(m, 2 * s - 1)          # max in-flight stash + 1
+    act0 = jnp.zeros_like(mb[0])
+    # derived from mb so it inherits data-axis vma when composed dp x pp
+    stash0 = jnp.broadcast_to(jnp.zeros_like(mb[0]),
+                              (cap,) + mb.shape[1:])
+    outs0 = jnp.zeros_like(mb)
+    dxs0 = jnp.zeros_like(mb)
+    # dp0 derives from the pipe-sharded params and is already varying
+    # over the axis; the x-derived zeros are invariant and need marking
+    dp0 = jax.tree.map(jnp.zeros_like, params_stage)
+    grad0 = jnp.zeros_like(mb[0])
+    act0, stash0, outs0, dxs0, grad0 = lax.pcast(
+        (act0, stash0, outs0, dxs0, grad0), (axis_name,), to="varying")
+    fwd_perm = [(st, st + 1) for st in range(s - 1)]
+    bwd_perm = [(st + 1, st) for st in range(s - 1)]
+
+    def tick(carry, t):
+        act_in, grad_in, stash, outs, dxs, dp = carry
+        # ---- forward half ------------------------------------------------
+        jf = t - idx
+        valid_f = jnp.logical_and(jf >= 0, jf < m)
+        jf_safe = jnp.clip(jf, 0, m - 1)
+        x_in = jnp.where(idx == 0, mb[jf_safe], act_in)
+        stash = lax.dynamic_update_index_in_dim(
+            stash,
+            jnp.where(valid_f, x_in, stash[jf_safe % cap]),
+            jf_safe % cap, 0)
+        y = block_apply(params_stage, x_in)
+        y = jnp.where(valid_f, y, jnp.zeros_like(y))
+        done = jnp.logical_and(idx == s - 1, valid_f)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(done, y, outs[jf_safe]), jf_safe, 0)
+        # ---- backward half -----------------------------------------------
+        jb = t - (2 * (s - 1) - idx)
+        valid_b = jnp.logical_and(jb >= 0, jb < m)
+        jb_safe = jnp.clip(jb, 0, m - 1)
+        # the last stage seeds its own backward from THIS tick's forward
+        # output (jb == jf there); other stages consume the hop
+        g_in = jnp.where(idx == s - 1, out_grad(y, jb_safe), grad_in)
+        x_saved = stash[jb_safe % cap]
+        _, pullback = jax.vjp(block_apply, params_stage, x_saved)
+        dparams_mb, dx_mb = pullback(g_in)
+        dx_mb = jnp.where(valid_b, dx_mb, jnp.zeros_like(dx_mb))
+        dp = jax.tree.map(
+            lambda acc, g: acc + jnp.where(valid_b, g,
+                                           jnp.zeros_like(g)),
+            dp, dparams_mb)
+        dxs = lax.dynamic_update_index_in_dim(
+            dxs,
+            jnp.where(jnp.logical_and(idx == 0, valid_b), dx_mb,
+                      dxs[jb_safe]),
+            jb_safe, 0)
+        # ---- hops --------------------------------------------------------
+        act_next = lax.ppermute(y, axis_name, fwd_perm)
+        grad_next = lax.ppermute(dx_mb, axis_name, bwd_perm)
+        return (act_next, grad_next, stash, outs, dxs, dp), None
+
+    (_, _, _, outs, dxs, dp), _ = lax.scan(
+        tick, (act0, grad0, stash0, outs0, dxs0, dp0),
+        jnp.arange(m + 2 * (s - 1)))
+    outs = lax.psum(
+        jnp.where(idx == s - 1, outs, jnp.zeros_like(outs)), axis_name)
+    dxs = lax.psum(
+        jnp.where(idx == 0, dxs, jnp.zeros_like(dxs)), axis_name)
+    y = outs.reshape((b,) + outs.shape[2:])
+    dx = dxs.reshape((b,) + dxs.shape[2:])
+    dp = jax.tree.map(lambda g: g[None], dp)   # back to a [1,...] stack
+    return y, dp, dx
+
+
+def gpipe_train_1f1b(block_apply, stacked_params, x, out_grad, mesh,
+                     pipe_axis="pipe", data_axis=None,
+                     microbatches=None):
+    """One pipelined forward+backward under the 1F1B schedule.
+
+    Same layout contract as :func:`gpipe_apply`; additionally
+    ``out_grad(y_mb, mb_index) -> dy_mb`` supplies the loss gradient of
+    each finished microbatch (close it over targets reshaped to
+    [microbatches, mb, ...]) — 1F1B needs it the moment a microbatch
+    drains, which is why this is a train-step primitive rather than an
+    autodiff-transparent forward.  Returns ``(y, param_grads, dx)``
+    with ``param_grads`` stacked [S, ...] like ``stacked_params``.
+    See the module docstring for the memory/bubble trade vs GPipe."""
+    from jax.sharding import PartitionSpec as P
+    n_stages = mesh.shape[pipe_axis]
+    stacked_s = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if stacked_s != n_stages:
+        raise ValueError("params stack %d blocks but the %r axis has %d "
+                         "stages" % (stacked_s, pipe_axis, n_stages))
+    m = microbatches if microbatches is not None else 2 * n_stages
+    local_b = x.shape[0] // (mesh.shape[data_axis] if data_axis else 1)
+    if local_b % m:
+        raise ValueError(
+            "per-shard batch %d (global %d%s) not divisible by %d "
+            "microbatches"
+            % (local_b, x.shape[0],
+               " over %s=%d" % (data_axis, mesh.shape[data_axis])
+               if data_axis else "", m))
+    param_spec = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
+    x_spec = P(data_axis)
+    fn = jax.shard_map(
+        functools.partial(_1f1b_local, block_apply=block_apply,
+                          out_grad=out_grad, n_stages=n_stages,
+                          microbatches=m, axis_name=pipe_axis),
+        mesh=mesh, in_specs=(param_spec, x_spec),
+        out_specs=(x_spec, param_spec, x_spec))
     return fn(stacked_params, x)

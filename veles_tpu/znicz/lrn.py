@@ -7,19 +7,24 @@ Re-creation of ``veles.znicz.normalization.LRNormalizerForward/Backward``
 
 Two device paths:
 
+- the **default**: the channel-window sum as ONE banded [C, C] matmul
+  (``_window_sum_mxu``) — LRN is memory-bound (round-3 ablation: ~19 %
+  of an AlexNet f32 step as n shifted HBM passes), and the band form
+  moves it onto the MXU for a few percent of extra (free) FLOPs.
+  Round-4 on-chip A/B: the biggest single perf win of the round
+  (docs/PERF.md).  Summation order differs from the numpy twin's
+  shifted adds by float-reassociation noise only (parity tests use
+  atol 1e-5 and pass).
 - ``use_pallas=True``: a **Pallas kernel pair** (forward + analytic
-  backward via ``jax.custom_vjp``): LRN is memory-bound, and the kernel
-  does the window accumulation and the power in one VMEM-resident pass
-  instead of the n shifted HBM reads XLA materializes for the
-  padded-slice formula.  The backward uses the closed form
+  backward via ``jax.custom_vjp``) with the closed form
   ``dx = g·den^-β − 2β·(α/n)·x·W(g·x·den^-(β+1))`` (W = the same
-  channel-window sum), so autodiff through the fused trainer works.
-  On non-TPU backends the same kernels run in Pallas interpret mode.
-- the default is the plain jnp padded-slice formula (bit-compatible
-  with the numpy twin).  It stays the default because tunneled
-  remote-compile environments (axon) cannot build Mosaic kernels at
-  production shapes — on a directly-attached TPU flip ``use_pallas``
-  on per layer or via ``root.common.engine.use_pallas``.
+  channel-window sum).  Since round 4 it is gridded (1024xC row tiles)
+  and compiles on the tunneled chip in ~18 s — but it LOSES end-to-end
+  (0.76x, docs/PERF.md): the ``pallas_call`` boundary blocks XLA from
+  fusing LRN into its neighbors, which the matmul form allows.  Kept
+  as the measured hand-kernel reference point
+  (``root.common.engine.use_pallas`` / per-layer ``use_pallas=True``);
+  on non-TPU backends it runs in Pallas interpret mode.
 """
 
 import functools
@@ -56,13 +61,62 @@ def _window_sum(v, n, xp, transpose=False):
     return acc
 
 
+def _band_matrix(c, n, dtype, transpose=False):
+    """The [C, C] 0/1 band whose matmul computes the channel-window sum:
+    ``(v @ B)[..., i] = sum_{off} v[..., i + off]`` over the same
+    asymmetric offsets as :func:`_window_sum`.  ``transpose=True`` gives
+    the window-sum over the negated offsets (the VJP's window)."""
+    half = n // 2
+    j = numpy.arange(c)
+    d = j[:, None] - j[None, :]        # B[j, i] = 1 iff j - i in window
+    lo, hi = -half, n - 1 - half
+    band = ((d >= lo) & (d <= hi)).astype(dtype)
+    return band.T if transpose else band
+
+
+def _window_sum_mxu(v, n, transpose=False):
+    """The channel window sum as ONE banded matmul: LRN's window
+    accumulation is the memory-bound 19 % of an AlexNet step when done
+    as n shifted HBM passes (docs/PERF.md); as a [.., C] x [C, C]
+    product it rides the MXU, reading and writing each activation
+    exactly once for a few % extra (essentially free) FLOPs."""
+    import jax.numpy as jnp
+    c = v.shape[-1]
+    band = jnp.asarray(_band_matrix(c, n, numpy.float32,
+                                    transpose=transpose), v.dtype)
+    return jnp.einsum("...c,cd->...d", v, band)
+
+
 def _pallas_interpret():
     return jax.default_backend() != "tpu"
 
 
+_LRN_BLOCK_ROWS = 1024
+
+
+def _lrn_grid(x):
+    """Flatten [..., C] to [N, C] and tile N into VMEM-sized row blocks.
+
+    The round-3 kernel mapped the WHOLE array into one kernel invocation
+    — at production shapes (128x55x55x96 f32 = 148 MB) Mosaic ground for
+    >20 min on the oversized block and the bench recorded a timeout
+    every round.  A trivial gridded kernel compiles on the same tunneled
+    chip in <1 s (round-4 probe), so the fix is simply a real grid:
+    1024xC row tiles (~0.4-1 MB VMEM each), rows independent because the
+    LRN window runs along C only.  Block-padding rows beyond N is safe —
+    padded rows produce garbage that is never written back."""
+    import jax.numpy as jnp
+    c = x.shape[-1]
+    flat = x.reshape(-1, c)
+    from jax.experimental import pallas as pl
+    grid = (pl.cdiv(flat.shape[0], _LRN_BLOCK_ROWS),)
+    spec = pl.BlockSpec((_LRN_BLOCK_ROWS, c), lambda i: (i, 0))
+    return flat, grid, spec
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
 def pallas_lrn(x, n, alpha, beta, k):
-    """Fused cross-channel LRN forward (Pallas)."""
+    """Fused cross-channel LRN forward (Pallas, gridded row tiles)."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
@@ -71,9 +125,12 @@ def pallas_lrn(x, n, alpha, beta, k):
         acc = _window_sum(xv * xv, n, jnp)
         o_ref[...] = xv / (k + (alpha / n) * acc) ** beta
 
-    return pl.pallas_call(
-        kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-        interpret=_pallas_interpret())(x)
+    flat, grid, spec = _lrn_grid(x)
+    out = pl.pallas_call(
+        kernel, grid=grid, in_specs=[spec], out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(flat.shape, flat.dtype),
+        interpret=_pallas_interpret())(flat)
+    return out.reshape(x.shape)
 
 
 def _pallas_lrn_fwd(x, n, alpha, beta, k):
@@ -94,10 +151,13 @@ def _pallas_lrn_bwd(n, alpha, beta, k, x, g):
                       2.0 * beta * c * xv *
                       _window_sum(inner, n, jnp, transpose=True))
 
+    flat, grid, spec = _lrn_grid(x)
+    gflat = g.reshape(flat.shape)
     dx = pl.pallas_call(
-        kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-        interpret=_pallas_interpret())(x, g)
-    return (dx,)
+        kernel, grid=grid, in_specs=[spec, spec], out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(flat.shape, flat.dtype),
+        interpret=_pallas_interpret())(flat, gflat)
+    return (dx.reshape(x.shape),)
 
 
 pallas_lrn.defvjp(_pallas_lrn_fwd, _pallas_lrn_bwd)
@@ -124,8 +184,10 @@ class LRNormalizerForward(ParamlessForward):
     def apply(self, params, x):
         if self.use_pallas:
             return pallas_lrn(x, self.n, self.alpha, self.beta, self.k)
-        import jax.numpy as jnp
-        return x / self._den(x * x, jnp)
+        # MXU path: one banded matmul instead of n shifted HBM passes
+        # (autodiff gives the transposed band for the backward)
+        acc = _window_sum_mxu(x * x, self.n)
+        return x / (self.k + (self.alpha / self.n) * acc) ** self.beta
 
     def apply_numpy(self, params, x):
         return x / self._den(x * x, numpy)

@@ -3,18 +3,103 @@
 Re-creation of ``veles.znicz.pooling`` (absent; SURVEY.md §2.9):
 MaxPooling, AvgPooling, MaxAbsPooling, StochasticPooling(±Abs, ±Depooling).
 
-TPU-first: ``lax.reduce_window`` — XLA's native windowed reduction.
+TPU-first: ``lax.reduce_window`` — XLA's native windowed reduction —
+whose autodiff emits ``SelectAndScatter`` for the backward.
+:func:`fast_max_pool` is a measured-and-rejected alternative kept for
+the record: a window-offset formulation with a hand-written VJP (int8
+argmax plane forward, ky*kx predicated dilated pads backward) built on
+the hypothesis that SelectAndScatter was the memory-bound backward
+bottleneck; the round-4 interleaved on-chip A/B showed the OPPOSITE —
+reduce_window trains AlexNet ~28 % faster end-to-end (7921 vs 6198
+img/s median; docs/PERF.md) because XLA:TPU's select-and-scatter is
+fine while the offset formulation's extra planes defeat fusion.  It
+stays exported (grad-parity-tested against the reduce_window oracle)
+for shapes where a recorded-argmax pooling is needed.
+
 MaxAbsPooling keeps the *signed* value whose magnitude wins (the Znicz
-semantic), built from two reductions.  Stochastic pooling samples a window
-element with probability proportional to its magnitude (Zeiler & Fergus),
-keyed by the unit's deterministic KeyTree so runs are reproducible.
+semantic), built from two reductions.  Stochastic pooling samples a
+window element with probability proportional to its magnitude (Zeiler &
+Fergus), keyed by the unit's deterministic KeyTree so runs are
+reproducible.
 """
 
+import functools
+
+import jax
 import numpy
 
 from ..prng.random_generator import KeyTree
 from .nn_units import ParamlessForward
 from .conv import _quad
+
+
+def _offset_slice(arr, oy, ox, sy, sx, oh, ow):
+    """The [b, oh, ow, c] plane of window element (oy, ox) across all
+    (strided) window positions of a padded input."""
+    return arr[:, oy:oy + (oh - 1) * sy + 1:sy,
+               ox:ox + (ow - 1) * sx + 1:sx, :]
+
+
+def _max_pool_core(x, window, strides, padding, use_abs, want_idx):
+    import jax.numpy as jnp
+    ky, kx = window
+    sy, sx = strides
+    (pt, pb), (pl, pr) = padding
+    pad_val = 0.0 if use_abs else -numpy.inf
+    xp_arr = jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)),
+                     constant_values=jnp.asarray(pad_val, x.dtype))
+    hp, wp = xp_arr.shape[1], xp_arr.shape[2]
+    oh, ow = (hp - ky) // sy + 1, (wp - kx) // sx + 1
+    best = key = idx = None
+    for k, (oy, ox) in enumerate(
+            (oy, ox) for oy in range(ky) for ox in range(kx)):
+        s = _offset_slice(xp_arr, oy, ox, sy, sx, oh, ow)
+        cur = jnp.abs(s) if use_abs else s
+        if best is None:
+            best, key = s, cur
+            idx = jnp.zeros(s.shape, jnp.int8) if want_idx else None
+        else:
+            better = cur > key  # strict: first max in window order wins
+            best = jnp.where(better, s, best)
+            key = jnp.where(better, cur, key)
+            if want_idx:
+                idx = jnp.where(better, jnp.int8(k), idx)
+    return best, idx
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def fast_max_pool(x, window, strides, padding, use_abs):
+    """Max (or max-|.|) pooling with a scatter-free backward; see the
+    module docstring.  ``window``/``strides`` are (y, x) ints,
+    ``padding`` is ((top, bottom), (left, right))."""
+    best, _ = _max_pool_core(x, window, strides, padding, use_abs, False)
+    return best
+
+
+def _fast_max_pool_fwd(x, window, strides, padding, use_abs):
+    best, idx = _max_pool_core(x, window, strides, padding, use_abs, True)
+    return best, (idx, x.shape)
+
+
+def _fast_max_pool_bwd(window, strides, padding, use_abs, res, g):
+    import jax.numpy as jnp
+    idx, xshape = res
+    ky, kx = window
+    sy, sx = strides
+    (pt, pb), (pl, pr) = padding
+    b, h, w, c = xshape
+    hp, wp = h + pt + pb, w + pl + pr
+    oh, ow = (hp - ky) // sy + 1, (wp - kx) // sx + 1
+    dxp = jnp.zeros((b, hp, wp, c), g.dtype)
+    for k, (oy, ox) in enumerate(
+            (oy, ox) for oy in range(ky) for ox in range(kx)):
+        contrib = jnp.where(idx == jnp.int8(k), g,
+                            jnp.zeros((), g.dtype))
+        dxp = _offset_slice(dxp.at, oy, ox, sy, sx, oh, ow).add(contrib)
+    return (dxp[:, pt:pt + h, pl:pl + w, :],)
+
+
+fast_max_pool.defvjp(_fast_max_pool_fwd, _fast_max_pool_bwd)
 
 
 class PoolingBase(ParamlessForward):
