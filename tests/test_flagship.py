@@ -1,0 +1,82 @@
+"""The flagship composition demo (VERDICT round-3 item 10): MHA +
+switch-MoE blocks pipelined over pp, batch over dp, experts over ep —
+forward parity vs the sequential oracle and one learning train step on
+the 8-device dp2 x pp2 x ep2 mesh."""
+
+import numpy
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.znicz.samples.flagship import (
+    demo_mesh, flagship_apply, flagship_reference, init_params,
+    train_step)
+
+B, T, D, S, E = 8, 6, 16, 2, 2
+
+
+def _data(seed=1):
+    rng = numpy.random.RandomState(seed)
+    x = jnp.asarray(rng.standard_normal((B, T, D)) * 0.5, jnp.float32)
+    tgt = jnp.asarray(rng.standard_normal((B, T, D)) * 0.5, jnp.float32)
+    return x, tgt
+
+
+def test_flagship_forward_matches_oracle():
+    params = init_params(stages=S, experts=E)
+    x, _ = _data()
+    mesh = demo_mesh()
+    y = flagship_apply(params, x, mesh, microbatches=2)
+    ref = flagship_reference(params, x, microbatches=2, data_shards=2)
+    assert numpy.allclose(numpy.asarray(y), numpy.asarray(ref),
+                          atol=1e-4), numpy.abs(
+        numpy.asarray(y) - numpy.asarray(ref)).max()
+
+
+def test_flagship_grads_match_oracle():
+    params = init_params(stages=S, experts=E)
+    x, tgt = _data()
+    mesh = demo_mesh()
+
+    def loss_sharded(p):
+        return ((flagship_apply(p, x, mesh, microbatches=2) - tgt)
+                ** 2).mean()
+
+    def loss_oracle(p):
+        return ((flagship_reference(p, x, microbatches=2,
+                                    data_shards=2) - tgt) ** 2).mean()
+
+    g_s = jax.grad(loss_sharded)(params)
+    g_o = jax.grad(loss_oracle)(params)
+    for name in g_s:
+        assert numpy.allclose(numpy.asarray(g_s[name]),
+                              numpy.asarray(g_o[name]), atol=1e-4), name
+
+
+def test_flagship_train_step_learns():
+    """One jitted SGD step at a time on the dp x pp x ep mesh; the
+    composition trains (loss strictly decreases over a few steps)."""
+    params = init_params(stages=S, experts=E)
+    x, tgt = _data(seed=2)
+    mesh = demo_mesh()
+    step = jax.jit(lambda p: train_step(p, x, tgt, mesh,
+                                        microbatches=2))
+    losses = []
+    for _ in range(12):
+        loss, params = step(params)
+        losses.append(float(loss))
+    assert all(l == l for l in losses), losses      # no NaNs
+    assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+
+
+def test_flagship_topk_routing_composes():
+    """The same composition with top-2 routing still matches its
+    oracle."""
+    params = init_params(stages=S, experts=E, seed=5)
+    x, _ = _data(seed=3)
+    mesh = demo_mesh()
+    y = flagship_apply(params, x, mesh, microbatches=2, k=2)
+    ref = flagship_reference(params, x, microbatches=2, data_shards=2,
+                             k=2)
+    assert numpy.allclose(numpy.asarray(y), numpy.asarray(ref),
+                          atol=1e-4)

@@ -1,6 +1,7 @@
 """Service units: REST inference, publisher, downloader, shell, stream
 loader (SURVEY §2.8 leftovers)."""
 
+import base64
 import json
 import os
 import tarfile
@@ -138,6 +139,54 @@ def test_publisher(trained, tmp_path):
     report = json.load(open(os.path.join(str(tmp_path), "report.json")))
     assert report["workflow"] == "MnistSimple"
     assert any(u["runs"] > 0 for u in report["units"])
+
+
+def test_publisher_ipynb_and_html_backends(trained, tmp_path):
+    """Round-trip the round-4 backends (VERDICT item 7): the notebook is
+    valid nbformat-4 JSON whose code cell rebinds the run's results; the
+    HTML page is self-contained and carries the same numbers."""
+    # a fake plot artifact so the embed path executes (1x1 PNG)
+    png = tmp_path / "plot.png"
+    png.write_bytes(base64.b64decode(
+        "iVBORw0KGgoAAAANSUhEUgAAAAEAAAABCAYAAAAfFcSJAAAADUlEQVR4nGNg"
+        "YGAAAAAEAAH2FzhVAAAAAElFTkSuQmCC"))
+
+    class FakePlot:
+        plot_name = "loss-curve"
+        path = str(png)
+    pub = Publisher(trained, directory=str(tmp_path),
+                    backends=("ipynb", "html"))
+    pub.link_decision(trained.decision)
+    import veles_tpu.publishing as publishing
+    info = publishing.gather_info(trained)
+    info["plots"].append({"name": FakePlot.plot_name,
+                          "path": FakePlot.path})
+    publishing.BACKENDS["ipynb"](info, str(tmp_path / "report.ipynb"))
+    publishing.BACKENDS["html"](info, str(tmp_path / "report.html"))
+
+    nb = json.load(open(os.path.join(str(tmp_path), "report.ipynb")))
+    assert nb["nbformat"] == 4
+    kinds = [c["cell_type"] for c in nb["cells"]]
+    assert "markdown" in kinds and "code" in kinds
+    code = next(c for c in nb["cells"] if c["cell_type"] == "code")
+    ns = {}
+    exec(code["source"], ns)  # noqa: S102 — the cell must be valid python
+    assert "best_validation_error_pt" in ns["results"]
+    # the plot rode along as an attachment
+    att_cells = [c for c in nb["cells"] if c.get("attachments")]
+    assert len(att_cells) == 1
+    ((_, att),) = att_cells[0]["attachments"].items()
+    assert "image/png" in att
+
+    html = open(os.path.join(str(tmp_path), "report.html")).read()
+    assert "MnistSimple" in html
+    assert "best_validation_error_pt" in html
+    assert "data:image/png;base64," in html
+
+    # the Publisher unit drives the same backends end-to-end
+    pub.run()
+    assert sorted(os.path.basename(p) for p in pub.published) == \
+        ["report.html", "report.ipynb"]
 
 
 def test_downloader_local_archive(tmp_path):

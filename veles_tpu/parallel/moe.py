@@ -107,6 +107,10 @@ def moe_reference(expert_apply, stacked_params, wr, x, capacity, k=1):
 
 def _moe_local(stacked_params, wr, x, *, expert_apply, capacity,
                axis_name, k):
+    """The per-shard body.  Also reused (inside a caller-owned
+    shard_map binding more axes) by znicz.samples.flagship — keep the
+    signature and the leading-local-expert-dim-1 params convention in
+    sync with it."""
     e_idx = lax.axis_index(axis_name)
     params_e = jax.tree.map(lambda p: p[0], stacked_params)
     b, d = x.shape

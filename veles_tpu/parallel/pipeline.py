@@ -58,7 +58,11 @@ def sequential_blocks(block_apply, stacked_params, x):
 def _gpipe_local(params_stage, x, *, block_apply, n_stages, microbatches,
                  axis_name):
     """Per-device schedule: stage ``idx`` runs microbatch ``t - idx`` at
-    tick ``t``; activations hop idx→idx+1 between ticks."""
+    tick ``t``; activations hop idx→idx+1 between ticks.
+
+    Also reused (inside a caller-owned shard_map binding more axes) by
+    znicz.samples.flagship — keep the signature and the
+    leading-local-stage-dim-1 params convention in sync with it."""
     idx = lax.axis_index(axis_name)
     params_stage = jax.tree.map(lambda p: p[0], params_stage)  # [1,...]→
     m = microbatches

@@ -1,14 +1,20 @@
 """Publisher: render a training-run report.
 
 Re-creation of /root/reference/veles/publishing/ (publisher.py:57 +
-backend registry): the reference gathered workflow info and plots and
-rendered to Confluence/Markdown/PDF/IPython-notebook templates.  The
-kept backends are **markdown** and **json** (Confluence XML-RPC and
-LaTeX toolchains are environment dependencies this build deliberately
-avoids); the gathered info set matches: workflow name/checksum, config,
+backend registry, 1103 LoC over 4 backends): the reference gathered
+workflow info and plots and rendered to Confluence/Markdown/PDF/
+IPython-notebook templates.  Backends here: **markdown**, **json**,
+**ipynb** (nbformat-4 JSON, dependency-free — the notebook opens in
+Jupyter with the results bound to a live ``results`` variable for
+follow-up analysis, plots embedded base64), and **html** (one
+self-contained static page, plots inlined).  Confluence (XML-RPC
+server) and PDF (LaTeX toolchain) remain deliberately dropped —
+environment dependencies, documented in docs/COMPONENTS.md.  The
+gathered info set matches the reference: workflow name/checksum,
 results, per-unit timing table, plot artifacts.
 """
 
+import base64
 import json
 import os
 import time
@@ -58,6 +64,36 @@ def render_json(info, path):
 
 @register_backend("markdown")
 def render_markdown(info, path):
+    lines = _md_report_lines(info)
+    if info["plots"]:
+        lines += ["", "## Plots", ""]
+        for p in info["plots"]:
+            lines.append("- %s: `%s`" % (p["name"], p["path"]))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def _embed_plots(info):
+    """(mime, b64, name) for each plot artifact that exists on disk."""
+    out = []
+    for p in info["plots"]:
+        path = p.get("path")
+        if not path or not os.path.exists(str(path)):
+            continue
+        ext = os.path.splitext(str(path))[1].lower().lstrip(".")
+        mime = {"png": "image/png", "jpg": "image/jpeg",
+                "jpeg": "image/jpeg", "svg": "image/svg+xml"}.get(ext)
+        if mime is None:
+            continue
+        with open(str(path), "rb") as f:
+            out.append((mime, base64.b64encode(f.read()).decode(),
+                        p["name"]))
+    return out
+
+
+def _md_report_lines(info):
+    """The shared markdown body (markdown + ipynb backends)."""
     lines = ["# %s — training report" % info["workflow"], "",
              "Generated: %s" % info["generated"],
              "Checksum: `%s`" % info["checksum"], "", "## Results", ""]
@@ -69,12 +105,85 @@ def render_markdown(info, path):
     for u in info["units"]:
         lines.append("| %s | %s | %d | %.4f |" %
                      (u["name"], u["class"], u["runs"], u["seconds"]))
-    if info["plots"]:
-        lines += ["", "## Plots", ""]
-        for p in info["plots"]:
-            lines.append("- %s: `%s`" % (p["name"], p["path"]))
+    return lines
+
+
+@register_backend("ipynb")
+def render_ipynb(info, path):
+    """nbformat-4 notebook: a markdown report cell, the results bound to
+    a live ``results`` dict in a code cell, and one markdown cell per
+    plot with the image embedded as a cell attachment (the reference's
+    IPythonNotebookBackend rendered the same report to a notebook
+    template; nbformat is plain JSON, so no dependency is needed)."""
+    cells = [{
+        "cell_type": "markdown", "metadata": {},
+        "source": "\n".join(_md_report_lines(info)),
+    }, {
+        "cell_type": "code", "metadata": {}, "outputs": [],
+        "execution_count": None,
+        # json.loads of an embedded literal, NOT a bare dict: None/
+        # True/NaN would render as null/true/NaN — invalid Python
+        # (python's json.loads accepts NaN/Infinity)
+        "source": "# the run's results, live for follow-up analysis\n"
+                  "import json\nresults = json.loads(%r)\nresults" %
+                  json.dumps(info["results"], default=str,
+                             sort_keys=True),
+    }]
+    for i, (mime, b64, name) in enumerate(_embed_plots(info)):
+        att = "plot%d.%s" % (i, mime.split("/")[1].split("+")[0])
+        cells.append({
+            "cell_type": "markdown", "metadata": {},
+            "attachments": {att: {mime: b64}},
+            "source": "### %s\n\n![%s](attachment:%s)" % (name, name,
+                                                          att),
+        })
+    nb = {"cells": cells,
+          "metadata": {"language_info": {"name": "python"}},
+          "nbformat": 4, "nbformat_minor": 5}
     with open(path, "w") as f:
-        f.write("\n".join(lines) + "\n")
+        json.dump(nb, f, indent=1, default=str)
+    return path
+
+
+@register_backend("html")
+def render_html(info, path):
+    """One self-contained static HTML page, plots inlined base64."""
+    from html import escape
+
+    def esc(v):
+        return escape(str(v), quote=True)
+
+    rows = "\n".join(
+        "<tr><td>%s</td><td>%s</td><td>%d</td><td>%.4f</td></tr>"
+        % (esc(u["name"]), esc(u["class"]), u["runs"], u["seconds"])
+        for u in info["units"])
+    results = "\n".join(
+        "<li><b>%s</b>: %s</li>" % (esc(k), esc(v))
+        for k, v in sorted(info["results"].items()))
+    plots = "\n".join(
+        '<h3>%s</h3><img alt="%s" src="data:%s;base64,%s"/>'
+        % (esc(name), esc(name), mime, b64)
+        for mime, b64, name in _embed_plots(info))
+    doc = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>%s — training report</title>
+<style>
+body{font-family:sans-serif;margin:2em;max-width:60em}
+table{border-collapse:collapse}td,th{border:1px solid #999;padding:.3em}
+img{max-width:100%%;border:1px solid #ccc}
+</style></head><body>
+<h1>%s — training report</h1>
+<p>Generated: %s<br>Checksum: <code>%s</code></p>
+<h2>Results</h2><ul>%s</ul>
+<h2>Units</h2>
+<table><tr><th>unit</th><th>class</th><th>runs</th><th>seconds</th></tr>
+%s</table>
+%s
+</body></html>
+""" % (esc(info["workflow"]), esc(info["workflow"]),
+       esc(info["generated"]), esc(info["checksum"]), results, rows,
+       plots)
+    with open(path, "w") as f:
+        f.write(doc)
     return path
 
 
@@ -102,7 +211,8 @@ class Publisher(Unit, IResultProvider):
     def run(self):
         os.makedirs(self.directory, exist_ok=True)
         info = gather_info(self._workflow)
-        ext = {"markdown": ".md", "json": ".json"}
+        ext = {"markdown": ".md", "json": ".json", "ipynb": ".ipynb",
+               "html": ".html"}
         self.published = []
         for backend in self.backends:
             path = os.path.join(self.directory,

@@ -1,0 +1,164 @@
+"""Flagship composition demo: a modern MoE transformer trained over a
+dp x pp x ep mesh.
+
+The "modern demo" SURVEY §5 contemplates (VERDICT round-3 item 10): the
+round-3/4 parallel primitives composed in ONE model —
+
+- each block = causal multi-head attention (the functional core of
+  ``znicz.attention`` / ``parallel.ring.attention_reference``) + an
+  RMS-norm + a **switch-MoE feed-forward** whose experts shard over the
+  ``expert`` mesh axis (``parallel.moe._moe_local``);
+- a stack of S identical blocks pipelined over the ``pipe`` axis with
+  the GPipe microbatch schedule (``parallel.pipeline._gpipe_local``);
+- the batch sharded over ``data``.
+
+All three axes live in ONE ``shard_map``: the pipeline ring ppermutes
+over ``pipe``, the MoE combine psums over ``expert``, and XLA inserts
+the gradient all-reduce over ``data`` — the full quintet minus sp/tp,
+which compose the same way (ring attention binds a ``seq`` axis;
+tensor sharding annotates the projections).
+
+``flagship_reference`` is the single-device oracle (sequential blocks,
+oracle MoE); the test asserts forward parity AND that one fused train
+step on the dp2 x pp2 x ep2 8-device mesh learns
+(tests/test_flagship.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from ...parallel.mesh import make_mesh
+from ...parallel.moe import _moe_local, moe_capacity, moe_reference
+from ...parallel.pipeline import _gpipe_local
+from ...parallel.ring import attention_reference
+
+
+def init_params(stages, experts, d=16, heads=2, hidden=32, seed=0):
+    """One stacked param tree: leading dim S (pipe), expert leaves
+    [S, E, ...]."""
+    rng = numpy.random.RandomState(seed)
+
+    def w(*shape, scale=0.25):
+        return jnp.asarray(rng.standard_normal(shape) * scale,
+                           jnp.float32)
+
+    return {
+        "qkv": w(stages, d, 3 * d),
+        "proj": w(stages, d, d),
+        "wr": w(stages, d, experts),
+        "w1": w(stages, experts, d, hidden),
+        "w2": w(stages, experts, hidden, d),
+    }
+
+
+def _rmsnorm(h):
+    return h * jax.lax.rsqrt(jnp.mean(h * h, axis=-1, keepdims=True) +
+                             1e-6)
+
+
+def _expert_ffn(p, h):
+    return jnp.maximum(h @ p["w1"], 0.0) @ p["w2"]
+
+
+def _attend_block(params, h, heads):
+    b, t, d = h.shape
+    qkv = _rmsnorm(h) @ params["qkv"]
+    q, k, v = (qkv[..., i * d:(i + 1) * d].reshape(b, t, heads,
+                                                   d // heads)
+               for i in range(3))
+    a = attention_reference(q, k, v, causal=True).reshape(b, t, d)
+    return h + a @ params["proj"]
+
+
+def _block_sharded(params, h, *, heads, capacity, k):
+    """One transformer block INSIDE the full-mesh shard_map: expert
+    leaves carry a leading local-expert dim (1), the MoE dispatch
+    psums over the bound ``expert`` axis."""
+    h = _attend_block(params, h, heads)
+    b, t, d = h.shape
+    flat = _rmsnorm(h).reshape(b * t, d)
+    moe = _moe_local({"w1": params["w1"], "w2": params["w2"]},
+                     params["wr"], flat, expert_apply=_expert_ffn,
+                     capacity=capacity, axis_name="expert", k=k)
+    return h + moe.reshape(b, t, d)
+
+
+def _block_oracle(params, h, *, heads, capacity, k):
+    """Same block on one device: oracle MoE over the full [E,...]
+    stack."""
+    h = _attend_block(params, h, heads)
+    b, t, d = h.shape
+    flat = _rmsnorm(h).reshape(b * t, d)
+    moe = moe_reference(_expert_ffn,
+                        {"w1": params["w1"], "w2": params["w2"]},
+                        params["wr"], flat, capacity, k=k)
+    return h + moe.reshape(b, t, d)
+
+
+def flagship_apply(params, x, mesh, heads=2, microbatches=None,
+                   capacity_factor=2.0, k=1):
+    """The pipelined sharded forward: x [B, T, D] with B over ``data``,
+    blocks over ``pipe``, experts over ``expert``."""
+    from jax.sharding import PartitionSpec as P
+    s = mesh.shape["pipe"]
+    e = mesh.shape["expert"]
+    dp = mesh.shape.get("data", 1)
+    m = microbatches if microbatches is not None else 2 * s
+    b, t, d = x.shape
+    tokens_per_mb = (b // dp // m) * t
+    capacity = moe_capacity(tokens_per_mb, e, capacity_factor, k)
+    block = functools.partial(_block_sharded, heads=heads,
+                              capacity=capacity, k=k)
+    specs = {"qkv": P("pipe"), "proj": P("pipe"), "wr": P("pipe"),
+             "w1": P("pipe", "expert"), "w2": P("pipe", "expert")}
+    fn = jax.shard_map(
+        functools.partial(_gpipe_local, block_apply=block, n_stages=s,
+                          microbatches=m, axis_name="pipe"),
+        mesh=mesh,
+        in_specs=({n: specs[n] for n in params}, P("data")),
+        out_specs=P("data"))
+    return fn(params, x)
+
+
+def flagship_reference(params, x, heads=2, microbatches=None,
+                       capacity_factor=2.0, k=1, data_shards=1,
+                       pipe_stages=None):
+    """Single-device oracle with the SAME capacity semantics: the
+    sharded path routes each (data shard, microbatch) independently, so
+    the oracle replays that slicing."""
+    s = jax.tree_util.tree_leaves(params)[0].shape[0] \
+        if pipe_stages is None else pipe_stages
+    m = microbatches if microbatches is not None else 2 * s
+    b, t, d = x.shape
+    tokens_per_mb = (b // data_shards // m) * t
+    e = params["wr"].shape[-1]
+    capacity = moe_capacity(tokens_per_mb, e, capacity_factor, k)
+    chunks = x.reshape(data_shards * m, b // data_shards // m, t, d)
+    outs = []
+    for chunk in chunks:
+        h = chunk
+        for i in range(s):
+            params_i = jax.tree.map(lambda p: p[i], params)
+            h = _block_oracle(params_i, h, heads=heads,
+                              capacity=capacity, k=k)
+        outs.append(h)
+    return jnp.concatenate(outs).reshape(b, t, d)
+
+
+def demo_mesh():
+    """The 8-device dp2 x pp2 x ep2 composition mesh (CPU-virtual in
+    tests, a pod slice in production)."""
+    return make_mesh({"data": 2, "pipe": 2, "expert": 2})
+
+
+def train_step(params, x, target, mesh, lr=0.05, **kwargs):
+    """One fused SGD step of the full composition; jit-able."""
+    def loss_fn(p):
+        y = flagship_apply(p, x, mesh, **kwargs)
+        return ((y - target) ** 2).mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    return loss, jax.tree.map(lambda p, g: p - lr * g, params, grads)
