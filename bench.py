@@ -62,14 +62,49 @@ MNIST_ANCHOR = 1_127_292.0
 V5E_BF16_PEAK = 197e12
 
 SPREAD = {}
+PARTIAL = {}          # stage results land here the moment they exist
 _T0 = time.perf_counter()
+_LAST = {"t": time.perf_counter(), "stage": "start"}
+# per-stage stall budget for the watchdog: generous — a contended
+# compile can take 10+ min; a wedged tunnel sits at 0% CPU forever
+WATCHDOG_S = float(os.environ.get("VELES_BENCH_WATCHDOG", 1500))
 
 
 def _stamp(msg):
     """Stage progress to stderr: compiles on a contended tunneled chip
     can take many minutes each — a silent bench is undebuggable."""
+    _LAST.update(t=time.perf_counter(), stage=msg)
     print("bench [%7.1fs] %s" % (time.perf_counter() - _T0, msg),
           file=sys.stderr, flush=True)
+
+
+def _start_watchdog():
+    """The axon tunnel can WEDGE a device call outright (observed: the
+    per-launch build futex-waiting at 0 %% CPU for 30+ min).  The bench
+    runs unattended at round end — rather than hang forever and lose
+    every number, a daemon thread prints whatever stages already
+    finished (plus an error naming the stalled stage) and exits."""
+    import threading
+
+    def watch():
+        while True:
+            time.sleep(15)
+            stalled = time.perf_counter() - _LAST["t"]
+            if stalled > WATCHDOG_S:
+                line = dict(PARTIAL)
+                line.setdefault("metric",
+                                "alexnet_train_images_per_sec_per_chip")
+                line.setdefault("unit", "images/sec/chip")
+                line["spread"] = SPREAD
+                line["error"] = (
+                    "watchdog: stage %r stalled %.0fs (wedged device "
+                    "call); partial results only" % (_LAST["stage"],
+                                                     stalled))
+                print(json.dumps(line), flush=True)
+                os._exit(2)
+
+    threading.Thread(target=watch, daemon=True,
+                     name="bench-watchdog").start()
 
 
 def _record(name, times):
@@ -334,6 +369,7 @@ if __name__ == "__main__":
         else:
             raise SystemExit("unknown stage %r" % stage)
         sys.exit(0)
+    _start_watchdog()
     # Pallas subprocess stages FIRST: on a directly-attached TPU, libtpu
     # is single-process, so the children must own the chip before this
     # process initializes JAX (every bench call below does)
@@ -349,22 +385,28 @@ if __name__ == "__main__":
     if gemm_error:
         print("bench: precise-gemm run failed: %s" % gemm_error,
               file=sys.stderr)
+    if lrn_ips is not None:
+        PARTIAL["pallas_lrn_images_per_sec"] = round(float(lrn_ips), 1)
+    if gemm_res is not None:
+        PARTIAL["precise_gemm"] = gemm_res
     scan_ips = bench_alexnet_scan(batch=BATCH)
+    PARTIAL.update(metric="alexnet_train_images_per_sec_per_chip",
+                   value=round(scan_ips, 1), unit="images/sec/chip",
+                   vs_baseline=round(scan_ips / ALEXNET_BASELINE, 3))
     bf16_ips = bench_alexnet_scan(batch=BATCH, compute_dtype="bfloat16",
                                   name="alexnet_bf16")
+    PARTIAL.update(alexnet_bf16_images_per_sec=round(bf16_ips, 1),
+                   bf16_speedup_vs_f32=round(bf16_ips / scan_ips, 3))
     step_ips, flops_per_step, flops_source = bench_alexnet_step(
         batch=BATCH)
+    PARTIAL["alexnet_step_images_per_sec"] = round(step_ips, 1)
     flops_per_image = flops_per_step / BATCH
     mnist_ips = bench_mnist()
-    line = {
-        "metric": "alexnet_train_images_per_sec_per_chip",
-        "value": round(scan_ips, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(scan_ips / ALEXNET_BASELINE, 3),
-        "alexnet_bf16_images_per_sec": round(bf16_ips, 1),
+    # PARTIAL already carries every stage's headline numbers (for the
+    # watchdog's partial line); only the end-of-run extras go on top
+    line = dict(PARTIAL)
+    line.update({
         "bf16_vs_baseline": round(bf16_ips / ALEXNET_BASELINE, 3),
-        "bf16_speedup_vs_f32": round(bf16_ips / scan_ips, 3),
-        "alexnet_step_images_per_sec": round(step_ips, 1),
         "flops_per_image": round(flops_per_image / 1e9, 3),
         "flops_source": flops_source,
         "f32_model_tflops_per_sec": round(
@@ -378,14 +420,11 @@ if __name__ == "__main__":
         "mnist_anchor_images_per_sec": round(mnist_ips, 1),
         "mnist_vs_anchor": round(mnist_ips / MNIST_ANCHOR, 3),
         "spread": SPREAD,
-    }
+    })
     if lrn_ips is not None:
-        line["pallas_lrn_images_per_sec"] = round(float(lrn_ips), 1)
         line["pallas_lrn_speedup"] = round(float(lrn_ips) / scan_ips, 3)
     else:
         line["pallas_lrn_error"] = lrn_error
-    if gemm_res is not None:
-        line["precise_gemm"] = gemm_res
-    else:
+    if gemm_res is None:
         line["precise_gemm_error"] = gemm_error
     print(json.dumps(line))

@@ -102,10 +102,21 @@ def test_web_status_end_to_end():
         assert json.loads(urllib.request.urlopen(req).read())["ok"]
         status = json.loads(urllib.request.urlopen(url).read())
         assert status["host2"]["epoch"] == 7
-        # HTML index renders
+        # HTML dashboard renders with a sparkline per metric history
+        for _ in range(2):  # second heartbeat so series have 2+ points
+            registry.update("MnistSimple", {
+                "epoch": entry["epoch"] + 1,
+                "metrics": entry["metrics"]})
         html = urllib.request.urlopen(
             "http://127.0.0.1:%d/" % server.port).read().decode()
         assert "MnistSimple" in html
+        assert "<svg" in html and "polyline" in html
+        # history endpoint carries the numeric series
+        hist = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/history" % server.port).read())
+        series = hist["MnistSimple"]["best_validation_error_pt"]
+        assert len(series) >= 3 and all(
+            isinstance(v, float) for v in series)
     finally:
         server.stop()
 

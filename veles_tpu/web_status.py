@@ -19,6 +19,7 @@ garbage collection.
 
 import html as html_mod
 import json
+import math
 import os
 import threading
 import time
@@ -30,7 +31,10 @@ from .units import Unit
 
 
 class StatusRegistry:
-    """Thread-safe workflow-status store with age-out."""
+    """Thread-safe workflow-status store with age-out and a bounded
+    per-metric history (the dashboard's chart source)."""
+
+    HISTORY = 200  # points kept per (workflow, metric)
 
     def __init__(self, gc_timeout=3600.0):
         # generous by default: reporters heartbeat once per EPOCH, and a
@@ -38,6 +42,7 @@ class StatusRegistry:
         # would invert the reference's dead-master GC intent
         self._lock = threading.Lock()
         self._entries = {}
+        self._history = {}
         self.gc_timeout = gc_timeout
 
     def update(self, key, payload):
@@ -45,14 +50,32 @@ class StatusRegistry:
                    if k not in ("t", "age")}  # reserved bookkeeping keys
         with self._lock:
             self._entries[key] = {**payload, "t": time.time()}
+            hist = self._history.setdefault(key, {})
+            for name, value in payload.get("metrics", {}).items():
+                if isinstance(value, bool) or \
+                        not isinstance(value, (int, float)) or \
+                        not math.isfinite(value):
+                    # a NaN/inf point would make /history invalid strict
+                    # JSON and poison the sparkline's min/max
+                    continue
+                series = hist.setdefault(name, [])
+                series.append(float(value))
+                del series[:-self.HISTORY]
 
     def snapshot(self):
         now = time.time()
         with self._lock:
             self._entries = {k: v for k, v in self._entries.items()
                              if now - v["t"] < self.gc_timeout}
+            self._history = {k: v for k, v in self._history.items()
+                             if k in self._entries}
             return {k: {**v, "age": round(now - v["t"], 1)}
                     for k, v in self._entries.items()}
+
+    def history(self):
+        with self._lock:
+            return {k: {m: list(s) for m, s in hist.items()}
+                    for k, hist in self._history.items()}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -73,27 +96,69 @@ class _Handler(BaseHTTPRequestHandler):
         route = urllib.parse.urlparse(self.path).path
         if route == "/status":
             self._send(200, json.dumps(self.registry.snapshot(), indent=2))
+        elif route == "/history":
+            self._send(200, json.dumps(self.registry.history(), indent=2))
         elif route == "/plots" or route.startswith("/plots/"):
             self._serve_plots(route)
         elif route == "/":
-            rows = []
-            for key, e in sorted(self.registry.snapshot().items()):
-                rows.append(
-                    "<tr><td>%s</td><td>%s</td><td>%s</td><td>%ss</td>"
-                    "</tr>" % (key, e.get("epoch", "-"),
-                               json.dumps(e.get("metrics", {})),
-                               e.get("age", 0)))
-            self._send(200, (
-                "<html><head><meta http-equiv=refresh content=5>"
-                "<title>veles_tpu status</title></head><body>"
-                "<h2>Workflows</h2><table border=1>"
-                "<tr><th>workflow</th><th>epoch</th><th>metrics</th>"
-                "<th>age</th></tr>%s</table>"
-                "<p><a href=\"/plots\">plots</a> · "
-                "<a href=\"/status\">status JSON</a></p></body></html>"
-                % "".join(rows)), "text/html")
+            self._send(200, self._dashboard(), "text/html")
         else:
             self._send(404, '{"error": "not found"}')
+
+    @staticmethod
+    def _sparkline(series, w=160, h=36):
+        """Inline-SVG polyline of a metric series (no JS, no deps)."""
+        if len(series) < 2:
+            return '<svg width="%d" height="%d"></svg>' % (w, h)
+        lo, hi = min(series), max(series)
+        span = (hi - lo) or 1.0
+        pts = " ".join(
+            "%.1f,%.1f" % (i * (w - 4) / (len(series) - 1) + 2,
+                           h - 3 - (v - lo) / span * (h - 6))
+            for i, v in enumerate(series))
+        return ('<svg width="%d" height="%d"><polyline points="%s" '
+                'fill="none" stroke="#26c" stroke-width="1.5"/></svg>'
+                % (w, h, pts))
+
+    def _dashboard(self):
+        """The live view: per workflow a status row plus one sparkline
+        per numeric metric across its heartbeat history (the reference
+        web/ dashboard's chart role, dependency-free)."""
+        esc = html_mod.escape
+        history = self.registry.history()
+        sections = []
+        for key, e in sorted(self.registry.snapshot().items()):
+            charts = "".join(
+                "<figure><figcaption>%s<br><small>last %s</small>"
+                "</figcaption>%s</figure>"
+                % (esc(name), esc("%.6g" % series[-1]),
+                   self._sparkline(series))
+                for name, series in sorted(
+                    history.get(key, {}).items()))
+            sections.append(
+                "<section><h3>%s</h3><p>epoch %s · %ss ago · %s units"
+                "</p><p><code>%s</code></p><div class=row>%s</div>"
+                "</section>"
+                % (esc(str(key)), esc(str(e.get("epoch", "-"))),
+                   esc(str(e.get("age", 0))),
+                   esc(str(e.get("units", "-"))),
+                   # CURRENT metrics verbatim — string metrics and
+                   # history-less externals must stay visible here
+                   esc(json.dumps(e.get("metrics", {}), default=str)),
+                   charts))
+        return (
+            "<!DOCTYPE html><html><head>"
+            "<meta http-equiv=refresh content=5>"
+            "<title>veles_tpu status</title><style>"
+            "body{font-family:sans-serif;margin:1.5em}"
+            "figure{display:inline-block;margin:.4em;text-align:center}"
+            "figcaption{font-size:.75em}section{border-bottom:1px solid "
+            "#ddd;padding:.5em 0}.row{display:flex;flex-wrap:wrap}"
+            "</style></head><body><h2>Workflows</h2>%s"
+            "<p><a href=\"/plots\">plots</a> · "
+            "<a href=\"/status\">status JSON</a> · "
+            "<a href=\"/history\">history JSON</a></p></body></html>"
+            % ("".join(sections) or "<p>no workflows reporting</p>"))
 
     def _serve_plots(self, route):
         """Minimal plots browser (the reference web/ dashboard role):

@@ -182,12 +182,21 @@ class LRNormalizerForward(ParamlessForward):
         return (self.k + (self.alpha / self.n) * acc) ** self.beta
 
     def apply(self, params, x):
+        import jax.numpy as jnp
+        from jax import lax
         if self.use_pallas:
             return pallas_lrn(x, self.n, self.alpha, self.beta, self.k)
         # MXU path: one banded matmul instead of n shifted HBM passes
         # (autodiff gives the transposed band for the backward)
         acc = _window_sum_mxu(x * x, self.n)
-        return x / (self.k + (self.alpha / self.n) * acc) ** self.beta
+        den = self.k + (self.alpha / self.n) * acc
+        if self.beta == 0.75:
+            # den^-3/4 = rsqrt(den) * sqrt(rsqrt(den)) — two cheap HW
+            # ops instead of the exp/log pair a general pow lowers to
+            # (AlexNet's default beta; the generic path stays below)
+            r = lax.rsqrt(den)
+            return x * (r * jnp.sqrt(r))
+        return x / den ** self.beta
 
     def apply_numpy(self, params, x):
         return x / self._den(x * x, numpy)
