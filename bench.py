@@ -68,6 +68,7 @@ _LAST = {"t": time.perf_counter(), "stage": "start"}
 # per-stage stall budget for the watchdog: generous — a contended
 # compile can take 10+ min; a wedged tunnel sits at 0% CPU forever
 WATCHDOG_S = float(os.environ.get("VELES_BENCH_WATCHDOG", 1500))
+WATCHDOG_POLL_S = float(os.environ.get("VELES_BENCH_WATCHDOG_POLL", 15))
 
 
 def _stamp(msg):
@@ -88,7 +89,7 @@ def _start_watchdog():
 
     def watch():
         while True:
-            time.sleep(15)
+            time.sleep(WATCHDOG_POLL_S)
             stalled = time.perf_counter() - _LAST["t"]
             if stalled > WATCHDOG_S:
                 line = dict(PARTIAL)

@@ -1,0 +1,62 @@
+"""The bench stall watchdog: a wedged device call must not cost the
+round its numbers — the watchdog emits the already-finished stages as a
+partial JSON line and exits 2 (observed failure mode: the axon tunnel
+futex-wedging a call at 0% CPU for 30+ minutes)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_watchdog_emits_partial_results_and_exits():
+    probe = textwrap.dedent("""
+        import sys, time
+        sys.path.insert(0, %r)
+        import bench
+        bench.PARTIAL.update(
+            metric="alexnet_train_images_per_sec_per_chip",
+            value=123.4, unit="images/sec/chip")
+        bench.SPREAD["alexnet_f32"] = [1.0, 1.1, 3]
+        bench._stamp("stage that wedges")
+        bench._start_watchdog()
+        time.sleep(120)  # never stamps again -> watchdog fires
+    """) % REPO
+    env = dict(os.environ)
+    env["VELES_BENCH_WATCHDOG"] = "5"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", probe], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2, (proc.returncode, proc.stderr[-500:])
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["value"] == 123.4
+    assert line["spread"]["alexnet_f32"] == [1.0, 1.1, 3]
+    assert "watchdog" in line["error"]
+    assert "stage that wedges" in line["error"]
+
+
+def test_watchdog_does_not_fire_while_stages_progress():
+    """Stamps arriving faster than the budget keep the watchdog quiet —
+    poll interval shrunk below the probe's lifetime so the stall check
+    actually EVALUATES several times while stages progress."""
+    probe = textwrap.dedent("""
+        import sys, time
+        sys.path.insert(0, %r)
+        import bench
+        bench._start_watchdog()
+        for i in range(5):
+            bench._stamp("stage %%d" %% i)
+            time.sleep(2)
+        print("FINISHED-CLEAN")
+    """) % REPO
+    env = dict(os.environ)
+    env["VELES_BENCH_WATCHDOG"] = "6"
+    env["VELES_BENCH_WATCHDOG_POLL"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", probe], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert "FINISHED-CLEAN" in proc.stdout

@@ -1,6 +1,7 @@
 """Observability slice: JSONL events, plotters, web status (VERDICT #6:
 'a training run emits events.jsonl and serves /status JSON')."""
 
+import contextlib
 import json
 import os
 import urllib.request
@@ -15,6 +16,24 @@ from veles_tpu.web_status import StatusRegistry, StatusServer
 from veles_tpu.znicz.samples import mnist
 
 
+@contextlib.contextmanager
+def tracing_to(path):
+    """Enable JSONL tracing to ``path`` and FULLY reset the global
+    EventLog afterwards (shared by every tracing test — one place must
+    know EventLog's reset protocol)."""
+    root.common.trace.enabled = True
+    root.common.trace.file = str(path)
+    try:
+        yield events
+    finally:
+        root.common.trace.enabled = False
+        root.common.trace.file = None
+        events.close()
+        events._path = None
+        events._file = None
+        events.path = None
+
+
 def _make_wf(**kw):
     wf = mnist.create_workflow(
         loader={"minibatch_size": 100, "n_train": 300, "n_valid": 100,
@@ -26,18 +45,10 @@ def _make_wf(**kw):
 
 def test_training_emits_event_stream(tmp_path):
     path = str(tmp_path / "events.jsonl")
-    root.common.trace.enabled = True
-    root.common.trace.file = path
-    try:
+    with tracing_to(path):
         wf = _make_wf()
         events.event("custom", "single", note="hand-emitted")
         wf.run()
-    finally:
-        root.common.trace.enabled = False
-        root.common.trace.file = None
-        events.close()
-        events._path = None
-        events._file = None
     records = [json.loads(line) for line in open(path)]
     names = {r["name"] for r in records}
     assert "custom" in names
@@ -46,6 +57,23 @@ def test_training_emits_event_stream(tmp_path):
     assert spans and all("dur" in r for r in spans)
     assert any(r["args"]["cls"] == "MnistLoader" for r in spans
                if "args" in r)
+
+
+def test_logs_browser_serves_event_table(tmp_path):
+    """/logs renders the JSONL event log (the reference's /logs.html
+    Mongo browser role)."""
+    server = StatusServer(0, StatusRegistry())
+    try:
+        with tracing_to(tmp_path / "events.jsonl"):
+            events.event("browser-check", "single", unit="Probe")
+            events.span("timed-step", 0.25, cls="FusedStep")
+            html = urllib.request.urlopen(
+                "http://127.0.0.1:%d/logs" % server.port).read().decode()
+        assert "browser-check" in html
+        assert "timed-step" in html and "0.2500s" in html
+        assert "FusedStep" in html
+    finally:
+        server.stop()
 
 
 def test_plotters_serialize(tmp_path):

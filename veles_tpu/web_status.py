@@ -100,10 +100,62 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, json.dumps(self.registry.history(), indent=2))
         elif route == "/plots" or route.startswith("/plots/"):
             self._serve_plots(route)
+        elif route == "/logs":
+            self._serve_logs()
         elif route == "/":
             self._send(200, self._dashboard(), "text/html")
         else:
             self._send(404, '{"error": "not found"}')
+
+    def _serve_logs(self, tail=300):
+        """The reference's ``/logs.html`` Mongo browser, over the JSONL
+        event log: last ``tail`` trace records as an HTML table."""
+        from .logger import events
+        path = getattr(events, "path", None)
+        if not path or not os.path.isfile(path):
+            self._send(404, '{"error": "no event log yet (tracing '
+                            'writes %s)"}' % (path or "events dir"))
+            return
+        # bounded tail read: a long run's event log is huge — never
+        # materialize the whole file in the request thread
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 512 * 1024))
+            chunk = f.read().decode("utf-8", "replace")
+        lines = chunk.splitlines()
+        if size > 512 * 1024 and lines:
+            lines = lines[1:]  # drop the partial first line
+        lines = lines[-tail:]
+        esc = html_mod.escape
+        rows = []
+        for ln in lines:
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue  # foreign JSONL line; skip, don't 500
+            # Chrome-trace fields (logger.EventLog): ts/dur in us
+            rows.append(
+                "<tr><td>%.3fs</td><td>%s</td><td>%s</td><td>%s</td>"
+                "<td><code>%s</code></td></tr>"
+                % (rec.get("ts", 0) / 1e6, esc(str(rec.get("name"))),
+                   esc(str(rec.get("ph", ""))),
+                   esc("" if rec.get("dur") is None
+                       else "%.4fs" % (rec["dur"] / 1e6)),
+                   esc(json.dumps(rec.get("args", {}), default=str))
+                   if rec.get("args") else ""))
+        self._send(200, (
+            "<!DOCTYPE html><html><head><title>veles_tpu logs</title>"
+            "<style>body{font-family:sans-serif;margin:1.5em}"
+            "table{border-collapse:collapse}td,th{border:1px solid "
+            "#ccc;padding:.2em .5em;font-size:.85em}</style></head>"
+            "<body><h2>Event log (last %d of %s)</h2>"
+            "<table><tr><th>t</th><th>name</th><th>ph</th>"
+            "<th>duration</th><th>args</th></tr>%s</table>"
+            "</body></html>" % (len(rows), esc(path), "".join(rows))),
+            "text/html")
 
     @staticmethod
     def _sparkline(series, w=160, h=36):
@@ -156,6 +208,7 @@ class _Handler(BaseHTTPRequestHandler):
             "#ddd;padding:.5em 0}.row{display:flex;flex-wrap:wrap}"
             "</style></head><body><h2>Workflows</h2>%s"
             "<p><a href=\"/plots\">plots</a> · "
+            "<a href=\"/logs\">logs</a> · "
             "<a href=\"/status\">status JSON</a> · "
             "<a href=\"/history\">history JSON</a></p></body></html>"
             % ("".join(sections) or "<p>no workflows reporting</p>"))
