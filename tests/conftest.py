@@ -12,6 +12,17 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") +
     " --xla_force_host_platform_device_count=8").strip()
+# Detach every CLI/worker SUBPROCESS the tests spawn from the tunneled
+# TPU: the axon sitecustomize activates only when PALLAS_AXON_POOL_IPS
+# is set, and its get_backend shim initializes the axon client even
+# under JAX_PLATFORMS=cpu — when the shared tunnel wedges (observed: a
+# device call futex-parked for 30+ min) every `python -m veles_tpu`
+# child hangs at Device(backend="auto") and the suite never finishes.
+# Clearing the var here (children inherit) keeps the whole suite
+# hermetic from tunnel state; only bench.py, run outside pytest, uses
+# the real chip.  (This process itself already ran sitecustomize —
+# jax.config below retargets it.)
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 
 import jax  # noqa: E402
 

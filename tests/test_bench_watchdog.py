@@ -27,6 +27,7 @@ def test_watchdog_emits_partial_results_and_exits():
     """) % REPO
     env = dict(os.environ)
     env["VELES_BENCH_WATCHDOG"] = "5"
+    env["VELES_BENCH_WATCHDOG_POLL"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run([sys.executable, "-c", probe], env=env,
                           capture_output=True, text=True, timeout=120)

@@ -113,8 +113,9 @@ class _Handler(BaseHTTPRequestHandler):
         from .logger import events
         path = getattr(events, "path", None)
         if not path or not os.path.isfile(path):
-            self._send(404, '{"error": "no event log yet (tracing '
-                            'writes %s)"}' % (path or "events dir"))
+            self._send(404, json.dumps(
+                {"error": "no event log yet (tracing writes %s)"
+                          % (path or "events dir")}))
             return
         # bounded tail read: a long run's event log is huge — never
         # materialize the whole file in the request thread
@@ -136,14 +137,19 @@ class _Handler(BaseHTTPRequestHandler):
                 continue
             if not isinstance(rec, dict):
                 continue  # foreign JSONL line; skip, don't 500
-            # Chrome-trace fields (logger.EventLog): ts/dur in us
+            # Chrome-trace fields (logger.EventLog): ts/dur in us;
+            # foreign dicts may carry non-numeric values — skip, as
+            # above, rather than 500 the whole page
+            ts, dur = rec.get("ts", 0), rec.get("dur")
+            if not isinstance(ts, (int, float)) or \
+                    not isinstance(dur, (int, float, type(None))):
+                continue
             rows.append(
                 "<tr><td>%.3fs</td><td>%s</td><td>%s</td><td>%s</td>"
                 "<td><code>%s</code></td></tr>"
-                % (rec.get("ts", 0) / 1e6, esc(str(rec.get("name"))),
+                % (ts / 1e6, esc(str(rec.get("name"))),
                    esc(str(rec.get("ph", ""))),
-                   esc("" if rec.get("dur") is None
-                       else "%.4fs" % (rec["dur"] / 1e6)),
+                   esc("" if dur is None else "%.4fs" % (dur / 1e6)),
                    esc(json.dumps(rec.get("args", {}), default=str))
                    if rec.get("args") else ""))
         self._send(200, (
