@@ -96,6 +96,7 @@ def _start_watchdog():
                 line.setdefault("metric",
                                 "alexnet_train_images_per_sec_per_chip")
                 line.setdefault("unit", "images/sec/chip")
+                line.setdefault("value", None)  # keep the schema whole
                 line["spread"] = SPREAD
                 line["error"] = (
                     "watchdog: stage %r stalled %.0fs (wedged device "

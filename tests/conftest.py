@@ -23,7 +23,11 @@ os.environ["XLA_FLAGS"] = (
 # the real chip.  (This process itself already ran sitecustomize —
 # jax.config below retargets it.)
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
+# ...and with the shim gone, an inherited JAX_PLATFORMS=axon would make
+# children die with "unknown backend" — point them at cpu explicitly
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+

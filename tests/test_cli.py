@@ -3,6 +3,8 @@ the ``python -m veles_tpu workflow.py config.py`` surface — module loading,
 config override ordering, run(load, main) convention, dry-run levels,
 snapshot resume, and the result-file JSON."""
 
+import contextlib
+import copy
 import json
 import os
 import subprocess
@@ -48,24 +50,45 @@ def test_import_workflow_module_by_path_and_name():
     assert hasattr(m2, "run")
 
 
+@contextlib.contextmanager
+def _restored_mnist_config():
+    """In-process Main() runs mutate the GLOBAL root config; restore the
+    mnist subtree afterwards so later tests see the module defaults
+    (import first so the module's registration isn't inside the
+    snapshot window)."""
+    import veles_tpu.znicz.samples.mnist  # noqa: F401 — register defaults
+    from veles_tpu.config import root
+    snap = copy.deepcopy(root.mnist.todict())
+    try:
+        yield
+    finally:
+        node = root.__dict__["mnist"].__dict__
+        for key in [k for k in node if not k.startswith("_")]:
+            del node[key]  # public keys only; Config internals stay
+        root.mnist.update(snap)
+
+
 def test_dry_run_load_builds_without_device():
     """--dry-run load must build the workflow and stop before initialize."""
     from veles_tpu.__main__ import Main
-    main = Main([MNIST] + TINY + ["--dry-run", "load", "--backend", "cpu"])
-    assert main.run() == 0
-    wf = main.workflow
-    assert wf is not None
-    assert wf.decision.max_epochs == 2       # override took effect
-    assert not wf.is_finished
+    with _restored_mnist_config():
+        main = Main([MNIST] + TINY + ["--dry-run", "load",
+                                      "--backend", "cpu"])
+        assert main.run() == 0
+        wf = main.workflow
+        assert wf is not None
+        assert wf.decision.max_epochs == 2   # override took effect
+        assert not wf.is_finished
 
 
 def test_override_order_beats_module_defaults():
     """CLI overrides are applied AFTER the module registers its defaults."""
     from veles_tpu.__main__ import Main
-    main = Main([MNIST, "root.mnist.decision.max_epochs=7",
-                 "--dry-run", "load"])
-    main.run()
-    assert main.workflow.decision.max_epochs == 7
+    with _restored_mnist_config():
+        main = Main([MNIST, "root.mnist.decision.max_epochs=7",
+                     "--dry-run", "load"])
+        main.run()
+        assert main.workflow.decision.max_epochs == 7
 
 
 def test_cli_end_to_end_and_resume(tmp_path):

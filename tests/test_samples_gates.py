@@ -74,7 +74,10 @@ def test_mnist_accuracy_gate():
     from veles_tpu.znicz.samples import mnist
     prng.get().seed(42)
     wf = mnist.create_workflow(
-        loader={"minibatch_size": 60,
+        # sizes EXPLICIT (None = the full fixture): the gate must not
+        # inherit another test's in-process CLI overrides of the global
+        # config (e.g. a lingering n_train=300 trains to ~8%)
+        loader={"minibatch_size": 60, "n_train": None, "n_valid": None,
                 "prng": RandomGenerator().seed(3)},
         decision={"max_epochs": 25, "fail_iterations": 12,
                   "silent": True})
