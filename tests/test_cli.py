@@ -62,9 +62,8 @@ def _restored_mnist_config():
     try:
         yield
     finally:
-        node = root.__dict__["mnist"].__dict__
-        for key in [k for k in node if not k.startswith("_")]:
-            del node[key]  # public keys only; Config internals stay
+        for key in list(root.mnist.keys()):
+            delattr(root.mnist, key)
         root.mnist.update(snap)
 
 

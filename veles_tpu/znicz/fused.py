@@ -138,7 +138,13 @@ class FusedTrainStep(Unit, IResultProvider):
             h = x
             train = seed is not None
             if train and has_stochastic:
-                key = jax.random.PRNGKey(seed)
+                # rng_impl="rbg" swaps threefry for the TPU-cheap
+                # hardware RBG (dropout masks cost ~4% of an AlexNet
+                # step as threefry VPU work); default stays threefry —
+                # reproducible across backends
+                impl = root.common.engine.get("rng_impl",
+                                              "threefry2x32")
+                key = jax.random.key(seed, impl=impl)
             for i, fwd in enumerate(forwards[:-1]):
                 if train and fwd.stochastic:
                     h = fwd.apply_train(params[i], h,
