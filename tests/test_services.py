@@ -226,3 +226,72 @@ def test_stream_loader_serves_pushed_batches(trained):
     ld.close()
     ld.run()
     assert ld.finished
+
+
+def test_publisher_confluence_backend_over_xmlrpc(trained, tmp_path):
+    """The confluence backend speaks the reference's XML-RPC surface
+    (confluence2.login/getPage/storePage) — proven against an in-thread
+    stdlib stub server; offline (no url) it still writes the
+    storage-format XHTML artifact."""
+    import threading
+    from xmlrpc.server import (SimpleXMLRPCRequestHandler,
+                               SimpleXMLRPCServer)
+    import veles_tpu.publishing as publishing
+
+    class Handler(SimpleXMLRPCRequestHandler):
+        rpc_paths = ("/rpc/xmlrpc",)   # the Confluence endpoint path
+
+    stored = {}
+
+    class Confluence2:
+        def login(self, user, password):
+            stored["login"] = (user, password)
+            return "tok-1"
+
+        def getPage(self, token, space, title):
+            import xmlrpc.client
+            raise xmlrpc.client.Fault(500, "no such page")
+
+        def storePage(self, token, page):
+            stored["token"] = token
+            stored["page"] = page
+            return {**page, "id": "123",
+                    "url": "http://wiki/x/123"}
+
+        def logout(self, token):
+            stored["logout"] = token
+            return True
+
+    class Api:
+        confluence2 = Confluence2()
+
+    srv = SimpleXMLRPCServer(("127.0.0.1", 0), requestHandler=Handler,
+                             logRequests=False, allow_none=True)
+    srv.register_instance(Api(), allow_dotted_names=True)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        info = publishing.gather_info(trained)
+        out = publishing.BACKENDS["confluence"](
+            info, str(tmp_path / "report.xhtml"),
+            url="http://127.0.0.1:%d" % port,
+            username="u", password="p", space="ML",
+            parent=777)
+        assert out == "http://wiki/x/123"
+        assert stored["login"] == ("u", "p")
+        assert stored["token"] == "tok-1"
+        page = stored["page"]
+        assert page["space"] == "ML" and page["parentId"] == "777"
+        assert "best_validation_error_pt" in page["content"]
+        assert page["title"].startswith("MnistSimple")
+        assert stored["logout"] == "tok-1"
+        # artifact written too
+        xhtml = open(str(tmp_path / "report.xhtml")).read()
+        assert "<h2>Results</h2>" in xhtml
+        # offline mode: file only
+        out2 = publishing.BACKENDS["confluence"](
+            info, str(tmp_path / "r2.xhtml"))
+        assert out2 == str(tmp_path / "r2.xhtml")
+    finally:
+        srv.shutdown()

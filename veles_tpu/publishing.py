@@ -6,12 +6,14 @@ workflow info and plots and rendered to Confluence/Markdown/PDF/
 IPython-notebook templates.  Backends here: **markdown**, **json**,
 **ipynb** (nbformat-4 JSON, dependency-free — the notebook opens in
 Jupyter with the results bound to a live ``results`` variable for
-follow-up analysis, plots embedded base64), and **html** (one
-self-contained static page, plots inlined).  Confluence (XML-RPC
-server) and PDF (LaTeX toolchain) remain deliberately dropped —
-environment dependencies, documented in docs/COMPONENTS.md.  The
-gathered info set matches the reference: workflow name/checksum,
-results, per-unit timing table, plot artifacts.
+follow-up analysis, plots embedded base64), **html** (one
+self-contained static page, plots inlined), and **confluence**
+(storage-format XHTML published over the reference's XML-RPC surface
+via stdlib ``xmlrpc.client``; offline it writes the artifact only).
+PDF (LaTeX toolchain) remains deliberately dropped — an environment
+dependency, documented in docs/COMPONENTS.md.  The gathered info set
+matches the reference: workflow name/checksum, results, per-unit
+timing table, plot artifacts.
 """
 
 import base64
@@ -145,9 +147,9 @@ def render_ipynb(info, path):
     return path
 
 
-@register_backend("html")
-def render_html(info, path):
-    """One self-contained static HTML page, plots inlined base64."""
+def _xhtml_fragments(info):
+    """(results_ul, units_table, plots_html) — the XHTML body pieces
+    shared by the html and confluence backends."""
     from html import escape
 
     def esc(v):
@@ -157,13 +159,85 @@ def render_html(info, path):
         "<tr><td>%s</td><td>%s</td><td>%d</td><td>%.4f</td></tr>"
         % (esc(u["name"]), esc(u["class"]), u["runs"], u["seconds"])
         for u in info["units"])
-    results = "\n".join(
+    units = ("<table><tr><th>unit</th><th>class</th><th>runs</th>"
+             "<th>seconds</th></tr>\n%s</table>" % rows)
+    results = "<ul>%s</ul>" % "\n".join(
         "<li><b>%s</b>: %s</li>" % (esc(k), esc(v))
         for k, v in sorted(info["results"].items()))
     plots = "\n".join(
         '<h3>%s</h3><img alt="%s" src="data:%s;base64,%s"/>'
         % (esc(name), esc(name), mime, b64)
         for mime, b64, name in _embed_plots(info))
+    return results, units, plots
+
+
+@register_backend("confluence")
+def render_confluence(info, path, url=None, username=None, password=None,
+                      space=None, parent=None, page_title=None,
+                      timeout=120):
+    """The reference's Confluence backend, dependency-free: renders the
+    report as storage-format XHTML to ``path`` and, when ``url`` is
+    configured, publishes it over the same XML-RPC surface the
+    reference spoke (``confluence2.login/getPage/storePage``,
+    /root/reference/veles/publishing/confluence.py:66-110) via stdlib
+    ``xmlrpc.client``.  Without ``url`` the file artifact alone is the
+    result (offline mode).  The PUBLISHED body excludes plots — storage
+    format takes images as page attachments, not data: URIs — while
+    the local artifact keeps them inline."""
+    from html import escape
+    results, units, plots = _xhtml_fragments(info)
+    header = ("<p>Generated: %s<br/>Checksum: <code>%s</code></p>"
+              "<h2>Results</h2>%s<h2>Units</h2>%s"
+              % (escape(str(info["generated"])),
+                 escape(str(info["checksum"])), results, units))
+    with open(path, "w") as f:
+        f.write(header + plots)
+    if not url:
+        return path
+    import xmlrpc.client
+
+    class _TimeoutTransport(xmlrpc.client.Transport):
+        # no timeout would let a black-holed wiki wedge the workflow
+        # right after training (the reference set a socket default
+        # timeout for the same reason, confluence.py:60-64)
+        def make_connection(self, host):
+            conn = super().make_connection(host)
+            conn.timeout = timeout
+            return conn
+
+    proxy = xmlrpc.client.ServerProxy(url.rstrip("/") + "/rpc/xmlrpc",
+                                      allow_none=True,
+                                      transport=_TimeoutTransport())
+    api = proxy.confluence2
+    token = api.login(username, password)
+    try:
+        title = page_title or "%s training report" % info["workflow"]
+        try:
+            page = api.getPage(token, space, title)
+        except xmlrpc.client.Fault:
+            # Fault == "page missing" is the server's convention (the
+            # reference treats getPageSummary faults the same way); a
+            # permission/token fault will surface on storePage with
+            # the server's own message
+            page = {"space": space, "title": title}
+            if parent is not None:
+                page["parentId"] = str(parent)
+        page["content"] = header
+        stored = api.storePage(token, page)
+    finally:
+        api.logout(token)
+    return stored.get("url", path) if isinstance(stored, dict) else path
+
+
+@register_backend("html")
+def render_html(info, path):
+    """One self-contained static HTML page, plots inlined base64."""
+    from html import escape
+
+    def esc(v):
+        return escape(str(v), quote=True)
+
+    results, units_table, plots = _xhtml_fragments(info)
     doc = """<!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>%s — training report</title>
 <style>
@@ -173,15 +247,14 @@ img{max-width:100%%;border:1px solid #ccc}
 </style></head><body>
 <h1>%s — training report</h1>
 <p>Generated: %s<br>Checksum: <code>%s</code></p>
-<h2>Results</h2><ul>%s</ul>
+<h2>Results</h2>%s
 <h2>Units</h2>
-<table><tr><th>unit</th><th>class</th><th>runs</th><th>seconds</th></tr>
-%s</table>
+%s
 %s
 </body></html>
 """ % (esc(info["workflow"]), esc(info["workflow"]),
-       esc(info["generated"]), esc(info["checksum"]), results, rows,
-       plots)
+       esc(info["generated"]), esc(info["checksum"]), results,
+       units_table, plots)
     with open(path, "w") as f:
         f.write(doc)
     return path
@@ -200,6 +273,9 @@ class Publisher(Unit, IResultProvider):
         self.backends = tuple(kwargs.get("backends", ("markdown",)))
         self.directory = kwargs.get("directory", ".")
         self.basename = kwargs.get("basename", "report")
+        # per-backend options, e.g. {"confluence": {"url": ...,
+        # "username": ..., "password": ..., "space": ...}}
+        self.backend_options = dict(kwargs.get("backend_options", {}))
         self.complete = None      # linked: decision.complete
         self.published = []
 
@@ -212,12 +288,13 @@ class Publisher(Unit, IResultProvider):
         os.makedirs(self.directory, exist_ok=True)
         info = gather_info(self._workflow)
         ext = {"markdown": ".md", "json": ".json", "ipynb": ".ipynb",
-               "html": ".html"}
+               "html": ".html", "confluence": ".xhtml"}
         self.published = []
         for backend in self.backends:
             path = os.path.join(self.directory,
                                 self.basename + ext.get(backend, ".txt"))
-            self.published.append(BACKENDS[backend](info, path))
+            self.published.append(BACKENDS[backend](
+                info, path, **self.backend_options.get(backend, {})))
 
     def get_metric_values(self):
         return {"reports": list(self.published)}
