@@ -176,3 +176,13 @@ def test_plots_browser(tmp_path):
     finally:
         server.stop()
         del root.common.dirs.plots
+
+
+def test_memory_report_lines():
+    """Peak RSS (+ device peaks where the backend exposes them) — the
+    reference's exit-time memory report (__main__.py:787-799)."""
+    from veles_tpu.launcher import memory_report
+    lines = memory_report()
+    assert any("Peak host RSS" in ln for ln in lines), lines
+    mib = float([ln for ln in lines if "RSS" in ln][0].split()[3])
+    assert mib > 10, mib

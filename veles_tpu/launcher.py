@@ -27,6 +27,36 @@ import time
 from .config import root
 
 
+def memory_report(device=None):
+    """Peak host RSS + per-device HBM peak for the devices the RUN
+    actually used, as printable lines (the reference printed max RSS
+    and device memory at exit, /root/reference/veles/__main__.py:
+    787-799).  Only inspects ``device`` (the Launcher's) — never calls
+    global ``jax.devices()``, which could first-time-initialize an
+    unused (and possibly wedged tunneled) backend from an exit
+    diagnostic."""
+    lines = []
+    try:
+        import resource
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        if sys.platform == "darwin":
+            peak /= 1024.0  # BSD reports bytes, Linux kilobytes
+        lines.append("Peak host RSS: %.1f MiB" % (peak / 1024.0))
+    except Exception:  # noqa: BLE001 — diagnostics must never raise
+        pass
+    try:
+        for dev in getattr(device, "jax_devices", None) or []:
+            stats = dev.memory_stats() or {}
+            peak = stats.get("peak_bytes_in_use")
+            if peak:
+                lines.append(
+                    "Device %s peak memory: %.1f MiB" %
+                    (dev, peak / (1024.0 * 1024.0)))
+    except Exception:  # noqa: BLE001
+        pass
+    return lines
+
+
 class Launcher:
     """Owns device + lifecycle for one workflow run."""
 
@@ -108,3 +138,5 @@ class Launcher:
             print("Total run time: %.3f s" %
                   ((self.finish_time or time.time()) - self.start_time),
                   file=file or sys.stdout)
+        for line in memory_report(self.device):
+            print(line, file=file or sys.stdout)
