@@ -134,11 +134,14 @@ def test_web_status_end_to_end():
         for _ in range(2):  # second heartbeat so series have 2+ points
             registry.update("MnistSimple", {
                 "epoch": entry["epoch"] + 1,
-                "metrics": entry["metrics"]})
+                "metrics": entry["metrics"],
+                "graph": entry["graph"]})
         html = urllib.request.urlopen(
             "http://127.0.0.1:%d/" % server.port).read().decode()
         assert "MnistSimple" in html
         assert "<svg" in html and "polyline" in html
+        # heartbeats carry the workflow graph; dashboard renders it
+        assert "unit graph (dot)" in html and "digraph" in html
         # history endpoint carries the numeric series
         hist = json.loads(urllib.request.urlopen(
             "http://127.0.0.1:%d/history" % server.port).read())

@@ -44,16 +44,16 @@ def memory_report(device=None):
         lines.append("Peak host RSS: %.1f MiB" % (peak / 1024.0))
     except Exception:  # noqa: BLE001 — diagnostics must never raise
         pass
-    try:
-        for dev in getattr(device, "jax_devices", None) or []:
+    for dev in getattr(device, "jax_devices", None) or []:
+        try:  # per device: one platform's failure must not hide the rest
             stats = dev.memory_stats() or {}
             peak = stats.get("peak_bytes_in_use")
-            if peak:
-                lines.append(
-                    "Device %s peak memory: %.1f MiB" %
-                    (dev, peak / (1024.0 * 1024.0)))
-    except Exception:  # noqa: BLE001
-        pass
+        except Exception:  # noqa: BLE001
+            continue
+        if peak:
+            lines.append(
+                "Device %s peak memory: %.1f MiB" %
+                (dev, peak / (1024.0 * 1024.0)))
     return lines
 
 

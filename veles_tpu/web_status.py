@@ -193,9 +193,14 @@ class _Handler(BaseHTTPRequestHandler):
                    self._sparkline(series))
                 for name, series in sorted(
                     history.get(key, {}).items()))
+            graph = e.get("graph")
+            graph_html = (
+                "<details><summary>unit graph (dot)</summary>"
+                "<pre>%s</pre></details>" % esc(str(graph))
+                if graph else "")
             sections.append(
                 "<section><h3>%s</h3><p>epoch %s · %ss ago · %s units"
-                "</p><p><code>%s</code></p><div class=row>%s</div>"
+                "</p><p><code>%s</code></p><div class=row>%s</div>%s"
                 "</section>"
                 % (esc(str(key)), esc(str(e.get("epoch", "-"))),
                    esc(str(e.get("age", 0))),
@@ -203,7 +208,7 @@ class _Handler(BaseHTTPRequestHandler):
                    # CURRENT metrics verbatim — string metrics and
                    # history-less externals must stay visible here
                    esc(json.dumps(e.get("metrics", {}), default=str)),
-                   charts))
+                   charts, graph_html))
         return (
             "<!DOCTYPE html><html><head>"
             "<meta http-equiv=refresh content=5>"
@@ -327,6 +332,7 @@ class StatusReporter(Unit):
         self.registry = kwargs.get("registry") or default_registry
         self.epoch_ended = None      # linked
         self.epoch_number = None
+        self._graph_ = None          # computed once at first heartbeat
 
     def link_loader(self, loader):
         self.link_attrs(loader, "epoch_ended", "epoch_number")
@@ -340,10 +346,19 @@ class StatusReporter(Unit):
             metrics = wf.gather_results()
         except Exception:
             pass
+        if self._graph_ is None:
+            # the reference heartbeat carried the workflow graph
+            # (web_status.py:113); static after build — compute once,
+            # and NEVER let a cosmetic failure kill the training run
+            try:
+                self._graph_ = wf.generate_graph()
+            except Exception:
+                self._graph_ = ""
         self.registry.update(wf.name, {
             "epoch": self.epoch_number,
             "metrics": {k: v for k, v in metrics.items()
                         if isinstance(v, (int, float, str)) and
                         not isinstance(v, bool)},
             "units": len(list(wf)),
+            "graph": self._graph_,
         })
