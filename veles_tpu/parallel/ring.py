@@ -41,7 +41,9 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale,
                           vary_axes=None):
     """Per-shard body: local Q stays put, K/V blocks ride the ring.
 
-    q/k/v: [B, T_local, H, D] (this device's sequence chunk)."""
+    q/k/v: [B, T_local, H, D] (this device's sequence chunk).  Also
+    reused (inside a caller-owned shard_map binding more axes) by
+    znicz.samples.flagship — keep the signature in sync with it."""
     n_dev = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape

@@ -10,13 +10,17 @@ round-3/4 parallel primitives composed in ONE model —
   ``expert`` mesh axis (``parallel.moe._moe_local``);
 - a stack of S identical blocks pipelined over the ``pipe`` axis with
   the GPipe microbatch schedule (``parallel.pipeline._gpipe_local``);
-- the batch sharded over ``data``.
+- the batch sharded over ``data``;
+- optionally the SEQUENCE sharded over ``seq``: pass ``seq_axis`` and
+  the attention inside every pipelined block becomes ring attention
+  (``parallel.ring._ring_attention_local``) — K/V chunks ride
+  ppermutes over the seq ring while activations ride the pipe ring.
 
-All three axes live in ONE ``shard_map``: the pipeline ring ppermutes
-over ``pipe``, the MoE combine psums over ``expert``, and XLA inserts
-the gradient all-reduce over ``data`` — the full quintet minus sp/tp,
-which compose the same way (ring attention binds a ``seq`` axis;
-tensor sharding annotates the projections).
+Up to FOUR mesh axes live in ONE ``shard_map`` program: the pipeline
+ring ppermutes over ``pipe``, the attention ring over ``seq``, the MoE
+combine psums over ``expert``, and XLA inserts the gradient all-reduce
+over ``data`` — the full quintet minus tp, which composes the same way
+(tensor sharding annotates the projections).
 
 ``flagship_reference`` is the single-device oracle (sequential blocks,
 oracle MoE); the test asserts forward parity AND that one fused train
@@ -25,6 +29,7 @@ step on the dp2 x pp2 x ep2 8-device mesh learns
 """
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -33,7 +38,7 @@ import numpy
 from ...parallel.mesh import make_mesh
 from ...parallel.moe import _moe_local, moe_capacity, moe_reference
 from ...parallel.pipeline import _gpipe_local
-from ...parallel.ring import attention_reference
+from ...parallel.ring import _ring_attention_local, attention_reference
 
 
 def init_params(stages, experts, d=16, heads=2, hidden=32, seed=0):
@@ -63,21 +68,31 @@ def _expert_ffn(p, h):
     return jnp.maximum(h @ p["w1"], 0.0) @ p["w2"]
 
 
-def _attend_block(params, h, heads):
+def _attend_block(params, h, heads, seq_axis=None, vary_axes=None):
     b, t, d = h.shape
     qkv = _rmsnorm(h) @ params["qkv"]
     q, k, v = (qkv[..., i * d:(i + 1) * d].reshape(b, t, heads,
                                                    d // heads)
                for i in range(3))
-    a = attention_reference(q, k, v, causal=True).reshape(b, t, d)
-    return h + a @ params["proj"]
+    if seq_axis is None:
+        a = attention_reference(q, k, v, causal=True)
+    else:
+        # inside the full-mesh shard_map: t is this shard's chunk and
+        # the K/V blocks ride the seq ring (flash recurrence)
+        a = _ring_attention_local(
+            q, k, v, axis_name=seq_axis, causal=True,
+            scale=1.0 / math.sqrt(d // heads), vary_axes=vary_axes)
+    return h + a.reshape(b, t, d) @ params["proj"]
 
 
-def _block_sharded(params, h, *, heads, capacity, k):
+def _block_sharded(params, h, *, heads, capacity, k, seq_axis=None,
+                   vary_axes=None):
     """One transformer block INSIDE the full-mesh shard_map: expert
     leaves carry a leading local-expert dim (1), the MoE dispatch
-    psums over the bound ``expert`` axis."""
-    h = _attend_block(params, h, heads)
+    psums over the bound ``expert`` axis, and (when ``seq_axis`` is
+    bound) attention rides the seq ring."""
+    h = _attend_block(params, h, heads, seq_axis=seq_axis,
+                      vary_axes=vary_axes)
     b, t, d = h.shape
     flat = _rmsnorm(h).reshape(b * t, d)
     moe = _moe_local({"w1": params["w1"], "w2": params["w2"]},
@@ -86,54 +101,68 @@ def _block_sharded(params, h, *, heads, capacity, k):
     return h + moe.reshape(b, t, d)
 
 
-def _block_oracle(params, h, *, heads, capacity, k):
+def _block_oracle(params, h, *, heads, capacity, k, seq_shards=1):
     """Same block on one device: oracle MoE over the full [E,...]
-    stack."""
+    stack.  Attention is GLOBAL over T (ring attention equals full
+    attention); the MoE queues replay per seq shard, matching the
+    sharded path's per-chunk routing."""
     h = _attend_block(params, h, heads)
     b, t, d = h.shape
-    flat = _rmsnorm(h).reshape(b * t, d)
-    moe = moe_reference(_expert_ffn,
-                        {"w1": params["w1"], "w2": params["w2"]},
-                        params["wr"], flat, capacity, k=k)
-    return h + moe.reshape(b, t, d)
+    normed = _rmsnorm(h)
+    outs = []
+    for c in range(seq_shards):
+        chunk = normed[:, c * (t // seq_shards):
+                       (c + 1) * (t // seq_shards)]
+        flat = chunk.reshape(-1, d)
+        moe = moe_reference(_expert_ffn,
+                            {"w1": params["w1"], "w2": params["w2"]},
+                            params["wr"], flat, capacity, k=k)
+        outs.append(moe.reshape(b, t // seq_shards, d))
+    return h + jnp.concatenate(outs, axis=1)
 
 
 def flagship_apply(params, x, mesh, heads=2, microbatches=None,
-                   capacity_factor=2.0, k=1):
+                   capacity_factor=2.0, k=1, seq_axis=None):
     """The pipelined sharded forward: x [B, T, D] with B over ``data``,
-    blocks over ``pipe``, experts over ``expert``."""
+    blocks over ``pipe``, experts over ``expert`` — and T over
+    ``seq_axis`` when given (ring attention inside each stage)."""
     from jax.sharding import PartitionSpec as P
     s = mesh.shape["pipe"]
     e = mesh.shape["expert"]
     dp = mesh.shape.get("data", 1)
+    sp = mesh.shape.get(seq_axis, 1) if seq_axis else 1
     m = microbatches if microbatches is not None else 2 * s
     b, t, d = x.shape
-    tokens_per_mb = (b // dp // m) * t
+    tokens_per_mb = (b // dp // m) * (t // sp)
     capacity = moe_capacity(tokens_per_mb, e, capacity_factor, k)
+    vary = tuple(a for a in ("data", seq_axis)
+                 if a and a in mesh.shape) + ("pipe",)
     block = functools.partial(_block_sharded, heads=heads,
-                              capacity=capacity, k=k)
+                              capacity=capacity, k=k,
+                              seq_axis=seq_axis, vary_axes=vary)
     specs = {"qkv": P("pipe"), "proj": P("pipe"), "wr": P("pipe"),
              "w1": P("pipe", "expert"), "w2": P("pipe", "expert")}
+    x_spec = P("data", seq_axis) if seq_axis else P("data")
     fn = jax.shard_map(
         functools.partial(_gpipe_local, block_apply=block, n_stages=s,
                           microbatches=m, axis_name="pipe"),
         mesh=mesh,
-        in_specs=({n: specs[n] for n in params}, P("data")),
-        out_specs=P("data"))
+        in_specs=({n: specs[n] for n in params}, x_spec),
+        out_specs=x_spec)
     return fn(params, x)
 
 
 def flagship_reference(params, x, heads=2, microbatches=None,
                        capacity_factor=2.0, k=1, data_shards=1,
-                       pipe_stages=None):
+                       pipe_stages=None, seq_shards=1):
     """Single-device oracle with the SAME capacity semantics: the
-    sharded path routes each (data shard, microbatch) independently, so
-    the oracle replays that slicing."""
+    sharded path routes each (data shard, microbatch, seq chunk)
+    independently, so the oracle replays that slicing."""
     s = jax.tree_util.tree_leaves(params)[0].shape[0] \
         if pipe_stages is None else pipe_stages
     m = microbatches if microbatches is not None else 2 * s
     b, t, d = x.shape
-    tokens_per_mb = (b // data_shards // m) * t
+    tokens_per_mb = (b // data_shards // m) * (t // seq_shards)
     e = params["wr"].shape[-1]
     capacity = moe_capacity(tokens_per_mb, e, capacity_factor, k)
     chunks = x.reshape(data_shards * m, b // data_shards // m, t, d)
@@ -143,7 +172,8 @@ def flagship_reference(params, x, heads=2, microbatches=None,
         for i in range(s):
             params_i = jax.tree.map(lambda p: p[i], params)
             h = _block_oracle(params_i, h, heads=heads,
-                              capacity=capacity, k=k)
+                              capacity=capacity, k=k,
+                              seq_shards=seq_shards)
         outs.append(h)
     return jnp.concatenate(outs).reshape(b, t, d)
 
