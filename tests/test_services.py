@@ -295,3 +295,33 @@ def test_publisher_confluence_backend_over_xmlrpc(trained, tmp_path):
         assert out2 == str(tmp_path / "r2.xhtml")
     finally:
         srv.shutdown()
+
+
+def test_publisher_pdf_backend(trained, tmp_path):
+    """The dependency-free PDF backend emits a structurally valid PDF
+    whose (uncompressed) text streams carry the report."""
+    import veles_tpu.publishing as publishing
+    info = publishing.gather_info(trained)
+    out = publishing.BACKENDS["pdf"](info, str(tmp_path / "report.pdf"))
+    data = open(out, "rb").read()
+    assert data.startswith(b"%PDF-1.4")
+    assert data.rstrip().endswith(b"%%EOF")
+    assert b"/Type /Catalog" in data and b"/Helvetica" in data
+    # text rides in uncompressed streams: the report is greppable
+    assert b"MnistSimple" in data
+    assert b"best_validation_error_pt" in data
+    # xref offsets must actually point at their objects
+    xref_pos = int(data.rsplit(b"startxref", 1)[1].split()[0])
+    assert data[xref_pos:xref_pos + 4] == b"xref"
+    import re
+    offsets = re.findall(rb"(\d{10}) 00000 n", data)
+    for n, off in enumerate(offsets, start=1):
+        at = int(off)
+        assert data[at:at + len(b"%d 0 obj" % n)] == b"%d 0 obj" % n, n
+    # the Publisher unit round-trips it too
+    pub = Publisher(trained, directory=str(tmp_path), basename="r2",
+                    backends=("pdf",))
+    pub.link_decision(trained.decision)
+    pub.run()
+    assert pub.published[0].endswith("r2.pdf")
+    assert open(pub.published[0], "rb").read().startswith(b"%PDF")

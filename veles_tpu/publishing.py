@@ -7,13 +7,14 @@ IPython-notebook templates.  Backends here: **markdown**, **json**,
 **ipynb** (nbformat-4 JSON, dependency-free — the notebook opens in
 Jupyter with the results bound to a live ``results`` variable for
 follow-up analysis, plots embedded base64), **html** (one
-self-contained static page, plots inlined), and **confluence**
+self-contained static page, plots inlined), **confluence**
 (storage-format XHTML published over the reference's XML-RPC surface
-via stdlib ``xmlrpc.client``; offline it writes the artifact only).
-PDF (LaTeX toolchain) remains deliberately dropped — an environment
-dependency, documented in docs/COMPONENTS.md.  The gathered info set
-matches the reference: workflow name/checksum, results, per-unit
-timing table, plot artifacts.
+via stdlib ``xmlrpc.client``; offline it writes the artifact only), and
+**pdf** (a minimal hand-assembled PDF-1.4, no LaTeX).  All FOUR of the
+reference's report destinations (Confluence/Markdown/PDF/ipynb) are
+covered dependency-free, plus json and html.  The
+gathered info set matches the reference: workflow name/checksum,
+results, per-unit timing table, plot artifacts.
 """
 
 import base64
@@ -144,6 +145,81 @@ def render_ipynb(info, path):
           "nbformat": 4, "nbformat_minor": 5}
     with open(path, "w") as f:
         json.dump(nb, f, indent=1, default=str)
+    return path
+
+
+def _pdf_escape(text):
+    return (text.replace("\\", r"\\").replace("(", r"\(")
+            .replace(")", r"\)").encode("latin-1", "replace"))
+
+
+@register_backend("pdf")
+def render_pdf(info, path):
+    """A real PDF report with NO LaTeX and no dependencies: a minimal
+    hand-assembled PDF-1.4 (catalog/pages/Helvetica font, one
+    uncompressed text content stream per page).  The reference's PDF
+    backend shelled out to a LaTeX toolchain
+    (/root/reference/veles/publishing/pdf_backend.py) — the toolchain
+    is an environment dependency this build avoids; the capability
+    (results as a PDF artifact) is what this preserves."""
+    lines = ["%s - training report" % info["workflow"], "",
+             "Generated: %s" % info["generated"],
+             "Checksum: %s" % info["checksum"], "", "Results", ""]
+    for k, v in sorted(info["results"].items()):
+        lines.append("  %s: %s" % (k, v))
+    lines += ["", "Units", "",
+              "  %-28s %-24s %6s %10s" % ("unit", "class", "runs",
+                                          "seconds")]
+    for u in info["units"]:
+        lines.append("  %-28s %-24s %6d %10.4f"
+                     % (u["name"][:28], u["class"][:24], u["runs"],
+                        u["seconds"]))
+    if info["plots"]:
+        lines += ["", "Plot artifacts", ""]
+        lines += ["  %s: %s" % (p["name"], p["path"])
+                  for p in info["plots"]]
+
+    per_page = 54                       # 12pt leading inside 792pt page
+    pages = [lines[i:i + per_page] for i in range(0, len(lines),
+                                                  per_page)] or [[]]
+    objs = []                           # 1-indexed PDF objects
+    font_num = 3 + 2 * len(pages)
+    kids = " ".join("%d 0 R" % (3 + 2 * i) for i in range(len(pages)))
+    objs.append(b"<< /Type /Catalog /Pages 2 0 R >>")
+    objs.append(("<< /Type /Pages /Count %d /Kids [%s] >>"
+                 % (len(pages), kids)).encode())
+    for i, page_lines in enumerate(pages):
+        objs.append((
+            "<< /Type /Page /Parent 2 0 R /MediaBox [0 0 612 792] "
+            "/Resources << /Font << /F1 %d 0 R >> >> "
+            "/Contents %d 0 R >>" % (font_num, 4 + 2 * i)).encode())
+        body = [b"BT /F1 10 Tf 12 TL 50 760 Td"]
+        for ln in page_lines:
+            body.append(b"(" + _pdf_escape(ln) + b") Tj T*")
+        body.append(b"ET")
+        stream = b"\n".join(body)
+        objs.append(b"<< /Length %d >>\nstream\n%s\nendstream"
+                    % (len(stream), stream))
+    objs.append(b"<< /Type /Font /Subtype /Type1 "
+                b"/BaseFont /Helvetica /Encoding /WinAnsiEncoding >>")
+
+    out = [b"%PDF-1.4"]
+    offsets = []
+    pos = len(out[0]) + 1
+    for n, obj in enumerate(objs, start=1):
+        offsets.append(pos)
+        piece = b"%d 0 obj\n%s\nendobj" % (n, obj)
+        out.append(piece)
+        pos += len(piece) + 1
+    xref_pos = pos
+    xref = [b"xref", b"0 %d" % (len(objs) + 1),
+            b"0000000000 65535 f "]
+    xref += [b"%010d 00000 n " % off for off in offsets]
+    out += xref
+    out += [b"trailer", b"<< /Size %d /Root 1 0 R >>" % (len(objs) + 1),
+            b"startxref", b"%d" % xref_pos, b"%%EOF"]
+    with open(path, "wb") as f:
+        f.write(b"\n".join(out) + b"\n")
     return path
 
 
@@ -288,7 +364,7 @@ class Publisher(Unit, IResultProvider):
         os.makedirs(self.directory, exist_ok=True)
         info = gather_info(self._workflow)
         ext = {"markdown": ".md", "json": ".json", "ipynb": ".ipynb",
-               "html": ".html", "confluence": ".xhtml"}
+               "html": ".html", "confluence": ".xhtml", "pdf": ".pdf"}
         self.published = []
         for backend in self.backends:
             path = os.path.join(self.directory,
