@@ -257,11 +257,27 @@ def bench_mnist(batch=512, epochs=24, n_train=16384, repeats=10):
 
     _stamp("building mnist canary")
     wf = mnist.create_workflow(
+        # use_fixture=False: the canary must stay on the SYNTHETIC twin
+        # — the committed digits fixture caps at 12000 train rows, which
+        # would silently shrink the 16384-row epochs the round-1 anchor
+        # was measured on (and break the img/s accounting)
         loader={"minibatch_size": batch, "n_train": n_train,
-                "n_valid": batch, "prng": RandomGenerator().seed(3)},
+                "n_valid": batch, "use_fixture": False,
+                "prng": RandomGenerator().seed(3)},
         decision={"max_epochs": 10 ** 9, "silent": True},
         epoch_scan=True)
     wf.initialize(device=Device(backend="auto"))
+    from veles_tpu import loader as loader_mod
+    actual_train = wf.loader.class_lengths[loader_mod.TRAIN]
+    # if/raise, not assert (python -O would strip it), and provenance,
+    # not just row count (real IDX files in the datasets dir would
+    # still outrank use_fixture=False): anchor comparability must fail
+    # LOUDLY, never silently
+    if actual_train != n_train or wf.loader.provenance != "synthetic":
+        raise RuntimeError(
+            "canary dataset is %r with %d train rows; the round-1 "
+            "anchor needs the synthetic twin with %d"
+            % (wf.loader.provenance, actual_train, n_train))
     step = wf.fused_step
     # warmup with the SAME epoch-block size: a different scan length would
     # recompile inside the timed region
