@@ -40,6 +40,13 @@ Also reported in the same JSON line:
   dispatch/scan path.
 - ``spread`` — {name: [min_s, median_s, n]} per timed region, so
   contention claims are checkable from the JSON alone.
+
+Round-5 execution design (VERDICT r4 item 1a): the parent process is a
+JAX-FREE orchestrator; every stage runs as a killable subprocess under a
+global wall-clock budget (``VELES_BENCH_BUDGET``, default 2100 s), in
+HEADLINE-FIRST order behind a ~3-min liveness gate — a wedged tunnel now
+costs one stage timeout, never the whole record (round 4 lost its entire
+bench to optional-stages-first ordering + a wedged tunnel, rc=124).
 """
 
 import json
@@ -61,8 +68,8 @@ MNIST_ANCHOR = 1_127_292.0
 # TPU v5e peak: 197 TFLOP/s bf16 (f32 matmuls run at a fraction of that)
 V5E_BF16_PEAK = 197e12
 
+BATCH = 128  # shared by every AlexNet stage and the MFU math
 SPREAD = {}
-PARTIAL = {}          # stage results land here the moment they exist
 _T0 = time.perf_counter()
 _LAST = {"t": time.perf_counter(), "stage": "start"}
 # per-stage stall budget for the watchdog: generous — a contended
@@ -81,10 +88,11 @@ def _stamp(msg):
 
 def _start_watchdog():
     """The axon tunnel can WEDGE a device call outright (observed: the
-    per-launch build futex-waiting at 0 %% CPU for 30+ min).  The bench
-    runs unattended at round end — rather than hang forever and lose
-    every number, a daemon thread prints whatever stages already
-    finished (plus an error naming the stalled stage) and exits."""
+    per-launch build futex-waiting at 0 %% CPU for 30+ min).  Runs in
+    every STAGE CHILD — rather than hang forever, a daemon thread
+    prints a schema-whole partial line (any already-timed regions ride
+    in ``spread``, the error names the stalled stage) and exits 2; the
+    orchestrator parent harvests the line and moves on."""
     import threading
 
     def watch():
@@ -92,16 +100,13 @@ def _start_watchdog():
             time.sleep(WATCHDOG_POLL_S)
             stalled = time.perf_counter() - _LAST["t"]
             if stalled > WATCHDOG_S:
-                line = dict(PARTIAL)
-                line.setdefault("metric",
-                                "alexnet_train_images_per_sec_per_chip")
-                line.setdefault("unit", "images/sec/chip")
-                line.setdefault("value", None)  # keep the schema whole
-                line["spread"] = SPREAD
-                line["error"] = (
-                    "watchdog: stage %r stalled %.0fs (wedged device "
-                    "call); partial results only" % (_LAST["stage"],
-                                                     stalled))
+                line = {"metric": "alexnet_train_images_per_sec_per_chip",
+                        "unit": "images/sec/chip",
+                        "value": None,  # keep the schema whole
+                        "spread": SPREAD,
+                        "error": "watchdog: stage %r stalled %.0fs "
+                                 "(wedged device call); partial results "
+                                 "only" % (_LAST["stage"], stalled)}
                 print(json.dumps(line), flush=True)
                 os._exit(2)
 
@@ -294,29 +299,53 @@ def bench_mnist(batch=512, epochs=24, n_train=16384, repeats=10):
     return n_train * epochs / _record("mnist", times)
 
 
-def _stage_subprocess(stage, key, timeout=600):
-    """A bench stage in a KILLABLE subprocess: Mosaic compiles through
-    the tunneled (axon) remote-compile service historically wedged
-    (fixed in round 4 by gridding the kernels — both now compile in
-    ~15 s — but the isolation stays: one bad kernel must never take the
-    whole bench down; VERDICT r2 item 10).  Returns (payload, error)."""
+def _last_json_line(text):
+    """The last parseable JSON object line in ``text`` (or None) — a
+    watchdog-killed child still prints its partial line before dying."""
+    for raw in reversed(text.strip().splitlines()):
+        raw = raw.strip()
+        if not raw.startswith("{"):
+            continue
+        try:
+            return json.loads(raw)
+        except ValueError:
+            continue
+    return None
+
+
+def _stage_subprocess(stage, timeout):
+    """EVERY bench stage runs in a KILLABLE subprocess (round-5 design;
+    VERDICT r4 item 1a).  Rationale: (a) the tunneled (axon) device can
+    wedge any call outright — a subprocess dies by timeout, the parent
+    moves on with partial results; (b) on a directly-attached TPU libtpu
+    is single-process, and sequential children each own the chip in
+    turn; (c) the parent stays JAX-free, so nothing can hang the
+    orchestrator itself.  The child's in-process watchdog is set just
+    under our kill timeout so a wedged child still emits its partial
+    JSON line first.  Returns (line_dict_or_None, error_or_None)."""
     import subprocess
+    env = dict(os.environ)
+    env["VELES_BENCH_WATCHDOG"] = str(max(60, int(timeout) - 45))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
              "--stage", stage],
-            capture_output=True, timeout=timeout,
+            capture_output=True, timeout=timeout, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
-    except subprocess.TimeoutExpired:
-        return None, "stage %s timeout after %ds" % (stage, timeout)
+    except subprocess.TimeoutExpired as exc:
+        line = _last_json_line((exc.stdout or b"").decode())
+        return line, "stage %s timeout after %ds" % (stage, timeout)
+    line = _last_json_line(proc.stdout.decode())
+    if line is None:
+        return None, "stage %s exit %d, no JSON: %s" % (
+            stage, proc.returncode, proc.stderr.decode()[-500:])
     if proc.returncode:
-        return None, "exit %d: %s" % (proc.returncode,
-                                      proc.stderr.decode()[-500:])
-    try:
-        line = json.loads(proc.stdout.decode().strip().splitlines()[-1])
-        return line[key], None
-    except (ValueError, KeyError, IndexError) as exc:
-        return None, "bad stage output: %r" % exc
+        # keep BOTH the child's own error field and its stderr tail —
+        # a crash after the result line printed is otherwise blank
+        return line, "stage %s exit %d (partial kept): %s | stderr: %s" % (
+            stage, proc.returncode, line.get("error", "")[:300],
+            proc.stderr.decode()[-300:])
+    return line, None
 
 
 def bench_precise_gemm(n=4096, reps=8, repeats=6):
@@ -372,77 +401,145 @@ def bench_precise_gemm(n=4096, reps=8, repeats=6):
     }
 
 
-if __name__ == "__main__":
-    BATCH = 128  # shared by every AlexNet bench below and the MFU math
-    if "--stage" in sys.argv:  # subprocess entry: one isolated stage
-        stage = sys.argv[sys.argv.index("--stage") + 1]
-        if stage == "pallas_lrn":
-            ips = bench_alexnet_scan(batch=BATCH, use_pallas_lrn=True,
-                                     repeats=3, name="alexnet_pallas_lrn")
-            print(json.dumps({"pallas_lrn_images_per_sec": round(ips, 1),
-                              "spread": SPREAD}))
-        elif stage == "precise_gemm":
-            print(json.dumps({"precise_gemm": bench_precise_gemm(),
-                              "spread": SPREAD}))
-        else:
-            raise SystemExit("unknown stage %r" % stage)
-        sys.exit(0)
-    _start_watchdog()
-    # Pallas subprocess stages FIRST: on a directly-attached TPU, libtpu
-    # is single-process, so the children must own the chip before this
-    # process initializes JAX (every bench call below does)
-    _stamp("pallas-LRN stage (isolated subprocess)")
-    lrn_ips, lrn_error = _stage_subprocess(
-        "pallas_lrn", "pallas_lrn_images_per_sec")
-    if lrn_error:
-        print("bench: pallas-LRN run failed: %s" % lrn_error,
-              file=sys.stderr)
-    _stamp("precise-gemm stage (isolated subprocess)")
-    gemm_res, gemm_error = _stage_subprocess(
-        "precise_gemm", "precise_gemm")
-    if gemm_error:
-        print("bench: precise-gemm run failed: %s" % gemm_error,
-              file=sys.stderr)
-    if lrn_ips is not None:
-        PARTIAL["pallas_lrn_images_per_sec"] = round(float(lrn_ips), 1)
-    if gemm_res is not None:
-        PARTIAL["precise_gemm"] = gemm_res
-    scan_ips = bench_alexnet_scan(batch=BATCH)
-    PARTIAL.update(metric="alexnet_train_images_per_sec_per_chip",
-                   value=round(scan_ips, 1), unit="images/sec/chip",
-                   vs_baseline=round(scan_ips / ALEXNET_BASELINE, 3))
-    bf16_ips = bench_alexnet_scan(batch=BATCH, compute_dtype="bfloat16",
-                                  name="alexnet_bf16")
-    PARTIAL.update(alexnet_bf16_images_per_sec=round(bf16_ips, 1),
-                   bf16_speedup_vs_f32=round(bf16_ips / scan_ips, 3))
-    step_ips, flops_per_step, flops_source = bench_alexnet_step(
-        batch=BATCH)
-    PARTIAL["alexnet_step_images_per_sec"] = round(step_ips, 1)
-    flops_per_image = flops_per_step / BATCH
-    mnist_ips = bench_mnist()
-    # PARTIAL already carries every stage's headline numbers (for the
-    # watchdog's partial line); only the end-of-run extras go on top
-    line = dict(PARTIAL)
-    line.update({
-        "bf16_vs_baseline": round(bf16_ips / ALEXNET_BASELINE, 3),
-        "flops_per_image": round(flops_per_image / 1e9, 3),
-        "flops_source": flops_source,
-        "f32_model_tflops_per_sec": round(
-            flops_per_image * scan_ips / 1e12, 2),
-        "f32_mfu_vs_bf16_peak": round(
-            flops_per_image * scan_ips / V5E_BF16_PEAK, 4),
-        "bf16_model_tflops_per_sec": round(
-            flops_per_image * bf16_ips / 1e12, 2),
-        "bf16_mfu_vs_bf16_peak": round(
-            flops_per_image * bf16_ips / V5E_BF16_PEAK, 4),
-        "mnist_anchor_images_per_sec": round(mnist_ips, 1),
-        "mnist_vs_anchor": round(mnist_ips / MNIST_ANCHOR, 3),
-        "spread": SPREAD,
-    })
-    if lrn_ips is not None:
-        line["pallas_lrn_speedup"] = round(float(lrn_ips) / scan_ips, 3)
+def bench_liveness():
+    """Stage 0 gate: one tiny jitted matmul with a real D2H flush.  If
+    THIS can't finish, the tunnel is down and the orchestrator reports
+    immediately instead of burning its budget stage by stage."""
+    import numpy
+    import jax
+    import jax.numpy as jnp
+    _stamp("liveness probe")
+    t0 = time.perf_counter()
+    x = jnp.ones((512, 512), jnp.float32)
+    v = float(numpy.asarray(jax.jit(lambda a: a @ a)(x)[0, 0]))
+    assert v == 512.0, "liveness matmul produced %r" % v
+    return {"liveness_s": round(time.perf_counter() - t0, 1),
+            "platform": jax.devices()[0].platform}
+
+
+def _stage_main(stage):
+    """Subprocess entry: run one isolated stage, print its JSON line."""
+    _start_watchdog()  # a wedged device call still yields a partial line
+    if stage == "liveness":
+        out = bench_liveness()
+    elif stage == "alexnet_f32":
+        ips = bench_alexnet_scan(batch=BATCH)
+        out = {"alexnet_f32_images_per_sec": round(ips, 1)}
+    elif stage == "alexnet_bf16":
+        ips = bench_alexnet_scan(batch=BATCH, compute_dtype="bfloat16",
+                                 name="alexnet_bf16")
+        out = {"alexnet_bf16_images_per_sec": round(ips, 1)}
+    elif stage == "alexnet_step":
+        ips, flops_per_step, flops_source = bench_alexnet_step(batch=BATCH)
+        out = {"alexnet_step_images_per_sec": round(ips, 1),
+               "flops_per_step": flops_per_step,
+               "flops_source": flops_source}
+    elif stage == "mnist":
+        out = {"mnist_anchor_images_per_sec": round(bench_mnist(), 1)}
+    elif stage == "pallas_lrn":
+        ips = bench_alexnet_scan(batch=BATCH, use_pallas_lrn=True,
+                                 repeats=3, name="alexnet_pallas_lrn")
+        out = {"pallas_lrn_images_per_sec": round(ips, 1)}
+    elif stage == "precise_gemm":
+        out = {"precise_gemm": bench_precise_gemm()}
     else:
-        line["pallas_lrn_error"] = lrn_error
-    if gemm_res is None:
-        line["precise_gemm_error"] = gemm_error
-    print(json.dumps(line))
+        raise SystemExit("unknown stage %r" % stage)
+    out["spread"] = SPREAD
+    print(json.dumps(out))
+
+
+# (stage, per-stage timeout cap [s]) in run order: the liveness gate,
+# then the HEADLINE scan stages, then diagnostics, then the optional
+# hand-kernel stages LAST — round 4 lost its entire bench record to the
+# old optional-stages-first ordering when the tunnel wedged under a
+# ~2000-2700 s driver budget (BENCH_r04: rc=124 after 1200 s of optional
+# stages; VERDICT r4 item 1a).  Caps assume a contended first compile
+# can take 5-7 min (observed); the global budget below bounds the sum.
+STAGE_PLAN = [
+    ("liveness", 180),
+    ("alexnet_f32", 1200),
+    ("alexnet_bf16", 900),
+    ("alexnet_step", 600),
+    ("mnist", 600),
+    ("pallas_lrn", 300),
+    ("precise_gemm", 300),
+]
+
+
+def _orchestrate():
+    """JAX-free parent: run every stage as a killable subprocess under a
+    global wall-clock budget, then print the ONE schema-whole JSON line
+    from whatever completed."""
+    budget = float(os.environ.get("VELES_BENCH_BUDGET", 2100))
+    deadline = time.perf_counter() + budget
+    results, errors = {}, {}
+    for stage, cap in STAGE_PLAN:
+        remaining = deadline - time.perf_counter()
+        if remaining < 90:
+            errors[stage] = "skipped: bench budget exhausted"
+            _stamp("%s skipped (budget exhausted)" % stage)
+            continue
+        timeout = min(cap, remaining)
+        _stamp("stage %s (subprocess, timeout %ds)" % (stage, timeout))
+        line, err = _stage_subprocess(stage, timeout)
+        if err:
+            errors[stage] = err
+            print("bench: %s" % err, file=sys.stderr)
+        if line:
+            SPREAD.update(line.pop("spread", {}) or {})
+            # a watchdog-killed child prints the schema-whole partial
+            # line; strip its scaffolding so only real measurements merge
+            for k in ("error", "metric", "unit", "value", "vs_baseline"):
+                line.pop(k, None)
+            results.update({k: v for k, v in line.items()
+                            if v is not None})
+        if stage == "liveness" and "liveness_s" not in results:
+            # the gate itself failed: report NOW, don't burn the budget
+            print(json.dumps({
+                "metric": "alexnet_train_images_per_sec_per_chip",
+                "value": None, "unit": "images/sec/chip",
+                "vs_baseline": None, "spread": SPREAD,
+                "error": "tunnel down (liveness probe failed): %s"
+                         % errors.get(stage)}), flush=True)
+            sys.exit(2)
+
+    scan_ips = results.pop("alexnet_f32_images_per_sec", None)
+    line = {"metric": "alexnet_train_images_per_sec_per_chip",
+            "value": scan_ips, "unit": "images/sec/chip",
+            "vs_baseline": round(scan_ips / ALEXNET_BASELINE, 3)
+            if scan_ips else None}
+    line.update(results)
+    bf16_ips = results.get("alexnet_bf16_images_per_sec")
+    if bf16_ips:
+        line["bf16_vs_baseline"] = round(bf16_ips / ALEXNET_BASELINE, 3)
+        if scan_ips:
+            line["bf16_speedup_vs_f32"] = round(bf16_ips / scan_ips, 3)
+    flops_per_step = line.pop("flops_per_step", None)
+    if flops_per_step:
+        fpi = flops_per_step / BATCH
+        line["flops_per_image"] = round(fpi / 1e9, 3)
+        for tag, ips in (("f32", scan_ips), ("bf16", bf16_ips)):
+            if ips:
+                line["%s_model_tflops_per_sec" % tag] = round(
+                    fpi * ips / 1e12, 2)
+                line["%s_mfu_vs_bf16_peak" % tag] = round(
+                    fpi * ips / V5E_BF16_PEAK, 4)
+    mnist_ips = line.get("mnist_anchor_images_per_sec")
+    if mnist_ips:
+        line["mnist_vs_anchor"] = round(mnist_ips / MNIST_ANCHOR, 3)
+    # keep the RAW pallas number in the record (round-over-round
+    # comparability) and derive the speedup beside it when possible
+    lrn_ips = line.get("pallas_lrn_images_per_sec")
+    if lrn_ips and scan_ips:
+        line["pallas_lrn_speedup"] = round(lrn_ips / scan_ips, 3)
+    if errors:
+        line["stage_errors"] = errors
+    line["spread"] = SPREAD
+    print(json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    if "--stage" in sys.argv:
+        _stage_main(sys.argv[sys.argv.index("--stage") + 1])
+        sys.exit(0)
+    _orchestrate()

@@ -18,11 +18,13 @@ os.environ["XLA_FLAGS"] = (
 # under JAX_PLATFORMS=cpu — when the shared tunnel wedges (observed: a
 # device call futex-parked for 30+ min) every `python -m veles_tpu`
 # child hangs at Device(backend="auto") and the suite never finishes.
-# Clearing the var here (children inherit) keeps the whole suite
-# hermetic from tunnel state; only bench.py, run outside pytest, uses
-# the real chip.  (This process itself already ran sitecustomize —
-# jax.config below retargets it.)
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
+# Popping the var here (children inherit the absence) keeps the whole
+# suite hermetic from tunnel state; only bench.py, run outside pytest,
+# uses the real chip.  (This process itself already ran sitecustomize —
+# jax.config below retargets it.)  pop, not ""-assignment: the shim
+# gates on PRESENCE, so an empty-but-set var could still activate it
+# in children (ADVICE r4).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 # ...and with the shim gone, an inherited JAX_PLATFORMS=axon would make
 # children die with "unknown backend" — point them at cpu explicitly
 os.environ["JAX_PLATFORMS"] = "cpu"

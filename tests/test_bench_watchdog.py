@@ -17,9 +17,6 @@ def test_watchdog_emits_partial_results_and_exits():
         import sys, time
         sys.path.insert(0, %r)
         import bench
-        bench.PARTIAL.update(
-            metric="alexnet_train_images_per_sec_per_chip",
-            value=123.4, unit="images/sec/chip")
         bench.SPREAD["alexnet_f32"] = [1.0, 1.1, 3]
         bench._stamp("stage that wedges")
         bench._start_watchdog()
@@ -33,7 +30,7 @@ def test_watchdog_emits_partial_results_and_exits():
                           capture_output=True, text=True, timeout=120)
     assert proc.returncode == 2, (proc.returncode, proc.stderr[-500:])
     line = json.loads(proc.stdout.strip().splitlines()[-1])
-    assert line["value"] == 123.4
+    assert line["value"] is None  # schema stays whole
     assert line["spread"]["alexnet_f32"] == [1.0, 1.1, 3]
     assert "watchdog" in line["error"]
     assert "stage that wedges" in line["error"]
@@ -61,3 +58,42 @@ def test_watchdog_does_not_fire_while_stages_progress():
                           capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr[-500:]
     assert "FINISHED-CLEAN" in proc.stdout
+
+
+def test_orchestrator_reports_tunnel_down_fast():
+    """Round-5 design: the JAX-free orchestrator gates on a liveness
+    probe — when the device backend is unusable it must emit ONE
+    schema-whole JSON line with a tunnel-down error and exit 2 within
+    the probe timeout, never burn the budget stage by stage."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "no_such_platform"  # liveness child dies fast
+    env["VELES_BENCH_BUDGET"] = "600"
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          env=env, capture_output=True, text=True,
+                          timeout=300, cwd=REPO)
+    assert proc.returncode == 2, (proc.returncode, proc.stderr[-800:])
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "alexnet_train_images_per_sec_per_chip"
+    assert line["value"] is None and line["vs_baseline"] is None
+    assert "tunnel down" in line["error"]
+
+
+def test_stage_plan_is_headline_first():
+    """Round 4 lost its entire bench record to optional-stages-first
+    ordering (BENCH_r04 rc=124); the plan must keep the liveness gate
+    then the headline scans ahead of the optional hand-kernel stages."""
+    sys.path.insert(0, REPO)
+    import bench
+    order = [s for s, _ in bench.STAGE_PLAN]
+    assert order[0] == "liveness"
+    assert order[1] == "alexnet_f32"
+    assert order.index("alexnet_bf16") < order.index("pallas_lrn")
+    assert order.index("alexnet_f32") < order.index("precise_gemm")
+
+
+def test_last_json_line_recovers_partial_output():
+    sys.path.insert(0, REPO)
+    import bench
+    text = 'noise\n{"a": 1}\nmore noise\n{"b": 2, "spread": {}}\ntrailing'
+    assert bench._last_json_line(text) == {"b": 2, "spread": {}}
+    assert bench._last_json_line("no json here") is None
