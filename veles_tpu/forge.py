@@ -95,8 +95,14 @@ class ForgeStore:
             for version in sorted(os.listdir(base)):
                 mf = os.path.join(base, version, "manifest.json")
                 if os.path.exists(mf):
-                    with open(mf) as f:
-                        out.append(json.load(f))
+                    try:
+                        with open(mf) as f:
+                            out.append(json.load(f))
+                    except ValueError:
+                        # one interrupted upload's truncated manifest
+                        # must not hide every healthy package
+                        out.append({"name": name, "version": version,
+                                    "error": "corrupt manifest"})
         return out
 
 

@@ -227,11 +227,19 @@ def gpipe_train_1f1b(block_apply, stacked_params, x, out_grad, mesh,
 
     Same layout contract as :func:`gpipe_apply`; additionally
     ``out_grad(y_mb, mb_index) -> dy_mb`` supplies the loss gradient of
-    each finished microbatch (close it over targets reshaped to
-    [microbatches, mb, ...]) — 1F1B needs it the moment a microbatch
+    each finished microbatch — 1F1B needs it the moment a microbatch
     drains, which is why this is a train-step primitive rather than an
-    autodiff-transparent forward.  Returns ``(y, param_grads, dx)``
-    with ``param_grads`` stacked [S, ...] like ``stacked_params``.
+    autodiff-transparent forward.  ``out_grad`` runs INSIDE the
+    shard_map: with ``data_axis=None`` close it over targets reshaped
+    to [microbatches, mb, ...] and index with ``mb_index``; with a
+    ``data_axis`` set, ``y_mb`` is the PER-DATA-SHARD microbatch, so
+    the closure must first select its shard's targets via
+    ``lax.axis_index(data_axis)`` (e.g. ``lax.dynamic_index_in_dim`` on
+    targets reshaped to [shards, microbatches, mb_local, ...]) before
+    indexing with ``mb_index`` — see
+    ``tests/test_pipeline.py::test_1f1b_composes_with_data_axis`` for
+    the exact pattern.  Returns ``(y, param_grads, dx)`` with
+    ``param_grads`` stacked [S, ...] like ``stacked_params``.
     See the module docstring for the memory/bubble trade vs GPipe."""
     from jax.sharding import PartitionSpec as P
     n_stages = mesh.shape[pipe_axis]

@@ -19,6 +19,7 @@ results, per-unit timing table, plot artifacts.
 
 import base64
 import json
+import logging
 import os
 import time
 
@@ -271,6 +272,12 @@ def render_confluence(info, path, url=None, username=None, password=None,
     if not url:
         return path
     import xmlrpc.client
+    if not url.lower().startswith("https://"):
+        # credentials ride the XML-RPC body in the clear; make a plain
+        # http wiki an explicit, logged decision (ADVICE r4)
+        logging.getLogger("publishing").warning(
+            "confluence url %r is not https: credentials will be sent "
+            "unencrypted", url)
 
     class _TimeoutTransport(xmlrpc.client.Transport):
         # no timeout would let a black-holed wiki wedge the workflow
@@ -290,11 +297,20 @@ def render_confluence(info, path, url=None, username=None, password=None,
         title = page_title or "%s training report" % info["workflow"]
         try:
             page = api.getPage(token, space, title)
-        except xmlrpc.client.Fault:
-            # Fault == "page missing" is the server's convention (the
-            # reference treats getPageSummary faults the same way); a
-            # permission/token fault will surface on storePage with
-            # the server's own message
+        except xmlrpc.client.Fault as fault:
+            # The server signals "page missing" with a Fault (the
+            # reference treats getPageSummary faults the same way) —
+            # but an auth/permission Fault must NOT be converted into
+            # a confusing create-path failure (ADVICE r4): re-raise
+            # anything that names a credentials problem.  The missing-
+            # page Fault usually echoes the requested title — strip it
+            # first so a workflow named e.g. "TokenLM" can't false-
+            # positive the keyword scan.
+            msg = str(fault.faultString or "").lower().replace(
+                title.lower(), "")
+            if any(w in msg for w in ("auth", "permission", "token",
+                                      "session", "denied", "credential")):
+                raise
             page = {"space": space, "title": title}
             if parent is not None:
                 page["parentId"] = str(parent)

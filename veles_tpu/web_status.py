@@ -102,6 +102,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._serve_plots(route)
         elif route == "/logs":
             self._serve_logs()
+        elif route == "/forge" or route.startswith("/forge/"):
+            self._serve_forge(route)
         elif route == "/":
             self._send(200, self._dashboard(), "text/html")
         else:
@@ -163,6 +165,73 @@ class _Handler(BaseHTTPRequestHandler):
             "</body></html>" % (len(rows), esc(path), "".join(rows))),
             "text/html")
 
+    def _serve_forge(self, route):
+        """Forge model-marketplace browser (the role of the reference's
+        node/gulp forge app, /root/reference/web/projects/forge/src/js,
+        rebuilt server-rendered and dependency-free): ``/forge`` lists
+        every model/version in the configured registry with download
+        links; ``/forge/<name>/<ver>/package.zip`` serves the package,
+        ``.../manifest.json`` the manifest.  The registry directory is
+        ``root.common.dirs.forge`` (a ForgeStore layout — the same one
+        ``python -m veles_tpu.forge serve`` publishes)."""
+        from .forge import ForgeStore
+        esc = html_mod.escape
+        directory = root.common.dirs.get("forge", None)
+        if not directory or not os.path.isdir(directory):
+            self._send(404, '{"error": "forge directory not configured '
+                            '(set root.common.dirs.forge)"}')
+            return
+        store = ForgeStore(directory)
+        parts = [p for p in route[len("/forge"):].split("/") if p]
+        if parts:
+            try:
+                if len(parts) != 3 or parts[2] not in ("package.zip",
+                                                       "manifest.json"):
+                    raise KeyError("bad forge path")
+                name, version, leaf = parts
+                if leaf == "manifest.json":
+                    self._send(200, json.dumps(
+                        store.manifest(name, version), indent=2))
+                    return
+                with open(store.package_path(name, version), "rb") as f:
+                    self._send(200, f.read(), "application/zip")
+            except (KeyError, OSError, ValueError) as e:
+                # ValueError: a corrupt manifest.json must 404 its own
+                # entry, not 500 the connection
+                self._send(404, json.dumps({"error": str(e)}))
+            return
+        rows = []
+        for mf in store.list():
+            name = str(mf.get("name", "?"))
+            version = str(mf.get("version", "?"))
+            quoted = "%s/%s" % (urllib.parse.quote(name),
+                                urllib.parse.quote(version))
+            extra = {k: v for k, v in mf.items()
+                     if k not in ("name", "version", "uploaded", "size")}
+            rows.append(
+                "<tr><td><b>%s</b></td><td>%s</td><td>%s</td>"
+                "<td>%.1f&nbsp;KiB</td><td><code>%s</code></td>"
+                '<td><a href="/forge/%s/package.zip">fetch</a> · '
+                '<a href="/forge/%s/manifest.json">manifest</a></td></tr>'
+                % (esc(name), esc(version),
+                   esc(time.strftime(
+                       "%Y-%m-%d %H:%M",
+                       time.localtime(float(mf.get("uploaded", 0))))),
+                   float(mf.get("size", 0)) / 1024.0,
+                   esc(json.dumps(extra, default=str)) if extra else "",
+                   quoted, quoted))
+        self._send(200, (
+            "<!DOCTYPE html><html><head><title>veles_tpu forge</title>"
+            "<style>body{font-family:sans-serif;margin:1.5em}"
+            "table{border-collapse:collapse}td,th{border:1px solid "
+            "#ccc;padding:.25em .6em;font-size:.9em}</style></head>"
+            "<body><h2>Forge registry (%s)</h2>"
+            "<table><tr><th>model</th><th>version</th><th>uploaded</th>"
+            "<th>size</th><th>metadata</th><th></th></tr>%s</table>"
+            "<p>%d package(s) · <a href=\"/\">dashboard</a></p>"
+            "</body></html>"
+            % (esc(directory), "".join(rows), len(rows))), "text/html")
+
     @staticmethod
     def _sparkline(series, w=160, h=36):
         """Inline-SVG polyline of a metric series (no JS, no deps)."""
@@ -220,6 +289,7 @@ class _Handler(BaseHTTPRequestHandler):
             "</style></head><body><h2>Workflows</h2>%s"
             "<p><a href=\"/plots\">plots</a> · "
             "<a href=\"/logs\">logs</a> · "
+            "<a href=\"/forge\">forge</a> · "
             "<a href=\"/status\">status JSON</a> · "
             "<a href=\"/history\">history JSON</a></p></body></html>"
             % ("".join(sections) or "<p>no workflows reporting</p>"))
