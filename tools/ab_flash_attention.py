@@ -1,0 +1,94 @@
+"""Interleaved on-chip A/B: flash-attention kernel pair vs the XLA
+oracle (``attention_reference``), forward-only and train-shaped
+(fwd+bwd), at long-context MHA shapes.
+
+Interleaved, not sequential: the shared tunneled chip has contention
+drift that can invert sequential same-process comparisons (round-4
+lesson, docs/PERF.md).  Each repetition times A then B back-to-back;
+the reported ratio uses per-pair minima.
+
+Usage:  python tools/ab_flash_attention.py [T ...]
+Prints one JSON line per shape.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from veles_tpu.parallel.ring import attention_reference  # noqa: E402
+from veles_tpu.znicz.flash_attention import flash_attention  # noqa: E402
+
+
+def _sync(x):
+    return float(numpy.asarray(jax.tree_util.tree_leaves(x)[0]).ravel()[0])
+
+
+def _time_pair(fa, fb, args, reps=12, chain=4):
+    """min-of-reps for two fns, interleaved; ``chain`` dependent calls
+    per dispatch amortize the ~14 ms tunnel RTT."""
+    ta, tb = [], []
+    for _ in range(reps):
+        for fn, acc in ((fa, ta), (fb, tb)):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            _sync(out)
+            acc.append((time.perf_counter() - t0) / chain)
+    return min(ta), min(tb)
+
+
+def ab_shape(b, t, h, d, causal=True, chain=4):
+    rng = numpy.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)) * 0.5,
+                           jnp.float32) for _ in range(3))
+
+    def chained(attend):
+        def run(q, k, v):
+            out = q
+            for _ in range(chain):  # data-dependent: one dispatch
+                out = attend(out, k, v)
+            return out
+        return jax.jit(run)
+
+    def train_shaped(attend):
+        def loss(q, k, v):
+            return jnp.sum(attend(q, k, v) ** 2)
+
+        def run(q, k, v):
+            out = q
+            for _ in range(chain):
+                g = jax.grad(loss)(out, k, v)
+                out = out - 1e-3 * g
+            return out
+        return jax.jit(run)
+
+    flash = lambda q, k, v: flash_attention(q, k, v, causal)  # noqa: E731
+    oracle = lambda q, k, v: attention_reference(  # noqa: E731
+        q, k, v, causal=causal)
+    res = {"shape": [b, t, h, d], "causal": causal}
+    for tag, wrap in (("fwd", chained), ("train", train_shaped)):
+        fa, fb = wrap(flash), wrap(oracle)
+        _sync(fa(q, k, v))  # compile
+        _sync(fb(q, k, v))
+        a, b_ = _time_pair(fa, fb, (q, k, v), chain=chain)
+        res.update({tag + "_flash_s": round(a, 5),
+                    tag + "_xla_s": round(b_, 5),
+                    tag + "_speedup": round(b_ / a, 3)})
+    return res
+
+
+if __name__ == "__main__":
+    ts = [int(a) for a in sys.argv[1:]] or [1024, 2048, 4096]
+    for t in ts:
+        # B*H scaled down as T grows: keep the oracle's [B,H,T,T]
+        # scores in HBM range
+        b = max(1, 4096 // t)
+        line = ab_shape(b, t, 8, 64)
+        print(json.dumps(line), flush=True)

@@ -1,0 +1,196 @@
+"""Minimal LMDB environment WRITER for test fixtures.
+
+Writes a format-correct LMDB 0.9 data.mdb (64-bit layout: meta pages
+selected by txnid, leaf/branch B+tree, F_BIGDATA overflow pages) with
+stdlib ``struct`` only — deliberately independent of the reader in
+``veles_tpu/loader/lmdb.py`` so the tests check both against the wire
+format rather than against each other.  Scope: plain key->value main
+DB, sorted unique keys (exactly what caffe-style datasets use).
+
+Value protocol for dataset fixtures (``add_sample``): uint32 LE label +
+``.npy`` payload — the loader's ``default_decode`` counterpart.
+
+Usage as a script:
+    python tools/make_lmdb_fixture.py OUTDIR [n_samples]
+"""
+
+import io
+import os
+import struct
+import sys
+
+import numpy
+
+MDB_MAGIC = 0xBEEFC0DE
+MDB_VERSION = 1
+P_INVALID = 0xFFFFFFFFFFFFFFFF
+P_BRANCH, P_LEAF, P_OVERFLOW, P_META = 0x01, 0x02, 0x04, 0x08
+F_BIGDATA = 0x01
+PAGE_HDR, NODE_HDR = 16, 8
+
+
+def _page_header(pgno, flags, lower=0, upper=0, pages=None):
+    if pages is not None:          # overflow: lower/upper union = count
+        tail = struct.pack("<I", pages)
+    else:
+        tail = struct.pack("<HH", lower, upper)
+    return struct.pack("<QHH", pgno, 0, flags) + tail
+
+
+def _assemble(pgno, flags, nodes, psize):
+    """Pack node blobs into one page: ptr array up from byte 16, node
+    data down from the top (LMDB's layout)."""
+    lower = PAGE_HDR + 2 * len(nodes)
+    upper = psize
+    ptrs, blob_at = [], {}
+    for i, blob in enumerate(nodes):
+        upper -= len(blob)
+        ptrs.append(upper)
+        blob_at[upper] = blob
+    if upper < lower:
+        raise ValueError("nodes overflow page %d" % pgno)
+    page = bytearray(psize)
+    page[:PAGE_HDR] = _page_header(pgno, flags, lower, upper)
+    struct.pack_into("<%dH" % len(ptrs), page, PAGE_HDR, *ptrs)
+    for off, blob in blob_at.items():
+        page[off:off + len(blob)] = blob
+    return bytes(page)
+
+
+def _leaf_node(key, data):
+    dsize = len(data)
+    blob = struct.pack("<4H", dsize & 0xFFFF, dsize >> 16, 0,
+                       len(key)) + key + data
+    return blob + b"\0" * (len(blob) & 1)     # 2-byte node alignment
+
+
+def _bigdata_node(key, dsize, ov_pgno):
+    blob = struct.pack("<4H", dsize & 0xFFFF, dsize >> 16, F_BIGDATA,
+                       len(key)) + key + struct.pack("<Q", ov_pgno)
+    return blob + b"\0" * (len(blob) & 1)
+
+
+def _branch_node(key, child_pgno):
+    blob = struct.pack("<4H", child_pgno & 0xFFFF,
+                       (child_pgno >> 16) & 0xFFFF,
+                       (child_pgno >> 32) & 0xFFFF, len(key)) + key
+    return blob + b"\0" * (len(blob) & 1)
+
+
+def _meta_page(pgno, psize, root, depth, entries, last_pg, txnid,
+               branch_pages, leaf_pages, overflow_pages):
+    db_free = struct.pack("<IHH5Q", psize, 0, 0, 0, 0, 0, 0, P_INVALID)
+    db_main = struct.pack("<IHH5Q", 0, 0, depth, branch_pages,
+                          leaf_pages, overflow_pages, entries, root)
+    meta = (struct.pack("<II2Q", MDB_MAGIC, MDB_VERSION, 0,
+                        psize * (last_pg + 1)) +
+            db_free + db_main + struct.pack("<2Q", last_pg, txnid))
+    page = bytearray(psize)
+    page[:PAGE_HDR] = _page_header(pgno, P_META)
+    page[PAGE_HDR:PAGE_HDR + len(meta)] = meta
+    return bytes(page)
+
+
+def write_lmdb(directory, items, psize=4096, overflow_above=None):
+    """Write ``directory/data.mdb`` holding ``items`` (key->value,
+    keys written in sorted order).  Values longer than
+    ``overflow_above`` (default: what can't fit half a page) go to
+    F_BIGDATA overflow pages."""
+    if overflow_above is None:
+        overflow_above = psize // 2
+    items = sorted(items.items())
+    os.makedirs(directory, exist_ok=True)
+
+    next_pg = 2
+    pages = {}          # pgno -> bytes (may span multiple psize blocks)
+    leaves = []         # (first_key, pgno, node blobs)
+    cur_nodes, cur_first, cur_free = [], None, psize - PAGE_HDR
+    n_overflow = 0
+
+    def flush_leaf():
+        nonlocal cur_nodes, cur_first, cur_free, next_pg
+        if not cur_nodes:
+            return
+        pgno = next_pg
+        next_pg += 1
+        leaves.append((cur_first, pgno, list(cur_nodes)))
+        cur_nodes, cur_first, cur_free = [], None, psize - PAGE_HDR
+
+    for key, value in items:
+        if len(value) > overflow_above:
+            npages = (PAGE_HDR - 1 + len(value)) // psize + 1
+            ov_pgno = next_pg
+            next_pg += npages
+            blob = bytearray(npages * psize)
+            blob[:PAGE_HDR] = _page_header(ov_pgno, P_OVERFLOW,
+                                           pages=npages)
+            blob[PAGE_HDR:PAGE_HDR + len(value)] = value
+            pages[ov_pgno] = bytes(blob)
+            n_overflow += npages
+            node = _bigdata_node(key, len(value), ov_pgno)
+        else:
+            node = _leaf_node(key, value)
+        need = len(node) + 2
+        if need > cur_free:
+            flush_leaf()
+        if cur_first is None:
+            cur_first = key
+        cur_nodes.append(node)
+        cur_free -= need
+    flush_leaf()
+
+    for _, pgno, nodes in leaves:
+        pages[pgno] = _assemble(pgno, P_LEAF, nodes, psize)
+
+    if not leaves:
+        root, depth, n_branch = P_INVALID, 0, 0
+    elif len(leaves) == 1:
+        root, depth, n_branch = leaves[0][1], 1, 0
+    else:
+        root = next_pg
+        next_pg += 1
+        bnodes = [_branch_node(b"" if i == 0 else first, pgno)
+                  for i, (first, pgno, _) in enumerate(leaves)]
+        pages[root] = _assemble(root, P_BRANCH, bnodes, psize)
+        depth, n_branch = 2, 1
+
+    last_pg = next_pg - 1
+    out = bytearray((last_pg + 1) * psize)
+    out[0:psize] = _meta_page(0, psize, root, depth, len(items),
+                              last_pg, 0, n_branch, len(leaves),
+                              n_overflow)
+    out[psize:2 * psize] = _meta_page(1, psize, root, depth, len(items),
+                                      last_pg, 1, n_branch, len(leaves),
+                                      n_overflow)
+    for pgno, blob in pages.items():
+        out[pgno * psize:pgno * psize + len(blob)] = blob
+    path = os.path.join(directory, "data.mdb")
+    with open(path, "wb") as f:
+        f.write(out)
+    return path
+
+
+def encode_sample(image, label):
+    """The loader's default_decode counterpart: uint32 label + npy."""
+    buf = io.BytesIO()
+    numpy.save(buf, numpy.asarray(image, numpy.float32))
+    return struct.pack("<I", int(label)) + buf.getvalue()
+
+
+def make_dataset(directory, n=24, side=8, seed=0, overflow=False):
+    """A caffe-style keyed image env: keys "%08d", uniform tiny images
+    (the loader stacks them).  ``overflow=True`` lowers the overflow
+    threshold so every value takes the F_BIGDATA path — same decoded
+    content, different on-disk encoding."""
+    rng = numpy.random.RandomState(seed)
+    items = {("%08d" % i).encode():
+             encode_sample(rng.standard_normal((side, side)), i % 10)
+             for i in range(n)}
+    return write_lmdb(directory, items,
+                      overflow_above=128 if overflow else None)
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "lmdb_fixture"
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 24
+    print(make_dataset(out, n=n))

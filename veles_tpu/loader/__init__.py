@@ -16,3 +16,4 @@ from .sound import SndFileLoader                            # noqa: F401
 from .interactive import InteractiveLoader                  # noqa: F401
 from .restful import RestfulLoader, RestfulResponder        # noqa: F401
 from .hdfs import HdfsTextLoader, WebHdfsClient             # noqa: F401
+from .lmdb import LMDBFile, LMDBLoader                      # noqa: F401

@@ -32,7 +32,12 @@ class MultiHeadAttention(ForwardBase):
       mesh/seq_axis/data_axis: when a ``jax.sharding.Mesh`` with a seq
         axis is given, attention runs as RING attention over it
         (sequence parallelism; parallel/ring.py) — the single-device
-        math is identical.
+        math is identical;
+      use_pallas: route single-device attention through the flash
+        kernel pair (znicz/flash_attention.py — O(T*D) HBM traffic
+        instead of materialized [T, T] scores; defaults to
+        ``root.common.engine.use_pallas``).  The mesh/ring path above
+        takes precedence when both apply.
     """
 
     MAPPING = "multihead_attention"
@@ -44,6 +49,9 @@ class MultiHeadAttention(ForwardBase):
         self.mesh = kwargs.get("mesh")
         self.seq_axis = kwargs.get("seq_axis", "seq")
         self.data_axis = kwargs.get("data_axis")
+        from ..config import root
+        self.use_pallas = bool(kwargs.get(
+            "use_pallas", root.common.engine.get("use_pallas", False)))
         self.proj = Array()
         self.exports = ["weights", "proj", "bias"]
 
@@ -96,6 +104,12 @@ class MultiHeadAttention(ForwardBase):
                                   seq_axis=self.seq_axis,
                                   data_axis=self.data_axis,
                                   causal=self.causal)
+        if self.use_pallas:
+            # the flash kernel pair: O(T*D) HBM traffic instead of the
+            # oracle's materialized [T, T] scores (falls back to the
+            # oracle internally when T can't be tiled)
+            from .flash_attention import flash_attention
+            return flash_attention(q, k, v, self.causal)
         return attention_reference(q, k, v, causal=self.causal)
 
     def apply(self, params, x):

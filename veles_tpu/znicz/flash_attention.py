@@ -1,0 +1,347 @@
+"""Flash attention as a Pallas TPU kernel pair (forward + backward).
+
+The hand-kernel capability case the framework was missing (VERDICT r4
+item 4): LRN and GEMM hand kernels lost to XLA fusion because XLA
+already fuses memory-bound elementwise chains well — attention is the
+op where a hand kernel wins on TPU, because the win is ALGORITHMIC:
+``attention_reference`` (znicz/attention.py, parallel/ring.py:27)
+materializes the [B, H, T, T] score matrix through HBM, while this
+kernel streams K/V blocks through VMEM with the online-softmax
+recurrence and never materializes T x T anywhere.  HBM traffic drops
+from O(T^2) to O(T * D), so the advantage GROWS with sequence length —
+the regime the long-context/ring-attention story targets.
+
+VMEM stays O(block): every kernel walks K (or Q) blocks via a third
+grid dimension — Pallas pipelines the block DMAs while the online
+recurrence lives in VMEM scratch across the innermost grid steps (the
+canonical TPU flash structure).  Nothing is sized by T, so T=32k+
+compiles in the same footprint as T=1k.
+
+Same layout as the oracle: q/k/v [B, T, H, D] -> out [B, T, H, D];
+numerics match to f32 tolerance (asserted in
+tests/test_flash_attention.py).  The backward is the standard two-pass
+flash backward (dq pass over Q tiles, dk/dv pass over K tiles) driven
+by the forward's saved logsumexp — no [T, T] in the backward either.
+
+Wiring: ``MultiHeadAttention(use_pallas=True)`` (or the global
+``root.common.engine.use_pallas``) routes single-device attention here;
+shapes the kernel cannot tile (T with no block-divisor >= 32) fall back
+to the oracle with a logged warning, so the knob is always safe.
+"""
+
+import functools
+import logging
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_MIN_BLOCK = 32         # >= f32 sublane tile; smallest worthwhile tile
+_NEG_INF = float("-inf")
+_warned_shapes = set()
+
+
+def _blocks(t, block_q, block_k):
+    """(bq, bk) dividing T, searching down from the requested sizes;
+    None when no divisor >= _MIN_BLOCK exists."""
+    def fit(want):
+        cand = min(want, t)
+        while cand >= _MIN_BLOCK:
+            if t % cand == 0:
+                return cand
+            cand //= 2
+        return None
+
+    bq, bk = fit(block_q), fit(block_k)
+    if bq is None or bk is None:
+        return None
+    return bq, bk
+
+
+def flash_attention_supported(t, block_q=DEFAULT_BLOCK_Q,
+                              block_k=DEFAULT_BLOCK_K):
+    return _blocks(t, block_q, block_k) is not None
+
+
+def _block_needed(iq, jk, block_q, block_k):
+    """Causal: does Q block iq see any of K block jk?  (first key pos
+    <= last query pos)"""
+    return jk * block_k <= iq * block_q + block_q - 1
+
+
+def _mask_causal(s, iq, jk, block_q, block_k):
+    rows = iq * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = jk * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(cols > rows, _NEG_INF, s)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, *, scale, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    iq, jk = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(_block_needed(iq, jk, block_q, block_k) if causal
+             else jk >= 0)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale       # [BQ, D]
+        kb = k_ref[0].astype(jnp.float32)              # [BK, D]
+        vb = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [BQ, BK]
+        if causal:
+            s = _mask_causal(s, iq, jk, block_q, block_k)
+        m = m_scr[...]
+        new_m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # a fully-masked row keeps m at -inf: exp(-inf - -inf) must be
+        # 0, not nan (same guard as parallel/ring.py:77)
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - safe_m))
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1,
+                                                  keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = new_m
+
+    @pl.when(jk == n_k - 1)
+    def _finish():
+        m, l = m_scr[...], l_scr[...]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, :] = (jnp.where(jnp.isneginf(m), 0.0, m) +
+                         jnp.log(safe_l))[:, 0]
+
+
+def _flash_fwd_bh(q, k, v, scale, causal, block_q, block_k):
+    """Forward over [BH, T, D] operands; returns (out, lse[BH, T])."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q.shape
+    n_q, n_k = t // block_q, t // block_k
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k)
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    return pl.pallas_call(
+        kernel, grid=(bh, n_q, n_k),
+        in_specs=[qspec, kspec, kspec],
+        out_specs=[qspec,
+                   pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, t), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret())(q, k, v)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    iq, jk = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(_block_needed(iq, jk, block_q, block_k) if causal
+             else jk >= 0)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :][:, None]
+        delta = delta_ref[0, :][:, None]
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _mask_causal(s, iq, jk, block_q, block_k)
+        p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - lse))
+        dov = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [BQ, BK]
+        ds = p * (dov - delta)
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(jk == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    jk, iq = pl.program_id(1), pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_block_needed(iq, jk, block_q, block_k) if causal
+             else iq >= 0)
+    def _step():
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :][:, None]
+        delta = delta_ref[0, :][:, None]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _mask_causal(s, iq, jk, block_q, block_k)
+        p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - lse))
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dov = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dov - delta)
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(iq == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_bh(q, k, v, out, lse, do, scale, causal, block_q,
+                  block_k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q.shape
+    n_q, n_k = t // block_q, t // block_k
+    # delta_i = sum_d do*out — tiny elementwise reduce; XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                           # [BH, T]
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    qrow = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, n_q, n_k),
+        in_specs=[qspec, kspec, kspec, qspec, qrow, qrow],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret())(q, k, v, do, lse, delta)
+    # dk/dv pass: K block pinned per middle-grid step, Q streams inner
+    kq_spec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kq_row = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    kk_spec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, n_k, n_q),
+        in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, kq_row, kq_row],
+        out_specs=[kk_spec, kk_spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=_interpret())(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _to_bh(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_bh(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _warn_fallback(t):
+    if t >= 256 and t not in _warned_shapes:
+        _warned_shapes.add(t)
+        logging.getLogger("flash_attention").warning(
+            "T=%d has no block divisor >= %d: falling back to the XLA "
+            "oracle, which materializes the [T, T] scores (pad T to a "
+            "multiple of %d to engage the flash kernel)",
+            t, _MIN_BLOCK, _MIN_BLOCK)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Flash attention, [B, T, H, D] — drop-in for
+    ``attention_reference`` (falls back to it, with a logged warning,
+    when T can't be tiled)."""
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    from ..parallel.ring import attention_reference
+    b, t, h, d = q.shape
+    blocks = _blocks(t, block_q, block_k)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if blocks is None:
+        _warn_fallback(t)
+        out = attention_reference(q, k, v, causal=causal, scale=scale)
+        return out, (q, k, v, out, None)
+    bq, bk = blocks
+    out_bh, lse = _flash_fwd_bh(_to_bh(q), _to_bh(k), _to_bh(v),
+                                scale, causal, bq, bk)
+    out = _from_bh(out_bh, b, h)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, g):
+    from ..parallel.ring import attention_reference
+    q, k, v, out, lse = res
+    b, t, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if lse is None:  # untileable shape took the oracle path forward
+        _, vjp = jax.vjp(
+            lambda q, k, v: attention_reference(q, k, v, causal=causal,
+                                                scale=scale), q, k, v)
+        return vjp(g)
+    bq, bk = _blocks(t, block_q, block_k)
+    dq, dk, dv = _flash_bwd_bh(
+        _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(out), lse, _to_bh(g),
+        scale, causal, bq, bk)
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
