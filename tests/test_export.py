@@ -24,20 +24,25 @@ NATIVE_RUN = os.path.join(REPO, "native", "build", "veles_native_run")
 
 @pytest.fixture(scope="module")
 def native_build():
-    """Build the native runtime once (cmake+ninja are part of the image);
-    skip native tests only if the build itself fails."""
+    """Build the native runtime once.  A build failure is a test
+    FAILURE, not a skip (VERDICT r4 item 7): cmake+ninja ship in the
+    image, so the only way this fails is a real toolchain or source
+    regression — a skip would silently blank the whole §2.10 parity
+    surface."""
     if not os.path.exists(NATIVE_LIB):
         build = os.path.join(REPO, "native", "build")
         try:
             subprocess.run(["cmake", "-S", os.path.join(REPO, "native"),
                             "-B", build, "-G", "Ninja"],
-                           check=True, capture_output=True, timeout=120)
+                           check=True, capture_output=True, timeout=180)
             subprocess.run(["cmake", "--build", build], check=True,
                            capture_output=True, timeout=300)
-        except (subprocess.CalledProcessError,
-                subprocess.TimeoutExpired,
-                FileNotFoundError) as e:
-            pytest.skip("native build unavailable: %r" % e)
+        except subprocess.CalledProcessError as e:
+            pytest.fail("native build FAILED: %s\n%s"
+                        % (e, (e.stderr or b"").decode()[-2000:]),
+                        pytrace=False)
+        except (subprocess.TimeoutExpired, FileNotFoundError) as e:
+            pytest.fail("native build FAILED: %r" % e, pytrace=False)
     return NATIVE_LIB
 
 
@@ -125,6 +130,36 @@ def test_native_conv_stack(native_build, tmp_path):
     out = NativeWorkflow(path).run(x)
     assert out.shape == live.shape
     assert numpy.abs(out - live).max() < 5e-4
+
+
+def test_native_alexnet_end_to_end(native_build, tmp_path):
+    """The ACTUAL AlexNet workflow — all 15 layers, real kernel widths
+    (96/256/384/384/256 convs, LRN, overlapped 3x3/s2 pools, 4096-wide
+    FCs, 1000-way softmax) — exported and replayed by the native engine
+    (VERDICT r4 item 7: prove the conv path end-to-end, not just the
+    CIFAR quick net).  Input side 67 keeps the spatial math identical
+    in structure (15->7->3->1 through the pool stack) at CPU-test cost."""
+    import jax
+    from veles_tpu.znicz.samples import alexnet
+    wf = alexnet.create_workflow(
+        loader={"minibatch_size": 4, "n_train": 8, "n_valid": 4,
+                "side": 67, "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 1, "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    wf.run()
+    path = str(tmp_path / "alexnet.zip")
+    export_model(wf, path)
+    x = numpy.asarray(wf.loader.original_data.map_read()[:2])
+    live = numpy.asarray(jax.jit(forward_fn(wf.forwards))(
+        [f.params for f in wf.forwards], x))
+    from veles_tpu.export.native import NativeWorkflow
+    nat = NativeWorkflow(path)
+    assert nat.name == "AlexNet"
+    out = nat.run(x)
+    nat.close()
+    assert out.shape == live.shape == (2, 1000)
+    assert numpy.abs(out - live).max() < 5e-4
+    assert numpy.allclose(out.sum(axis=1), 1.0, atol=1e-4)  # softmax
 
 
 def test_native_attention(native_build, tmp_path):

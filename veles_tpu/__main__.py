@@ -47,6 +47,15 @@ def import_workflow_module(spec):
     lives inside a package tree (``__init__.py`` chain) is imported by its
     dotted name so its relative imports resolve."""
     if not os.path.exists(spec):
+        if "." not in spec:
+            # bare name: prefer the bundled sample of that name
+            # ("veles-tpu mnist" just works from an installed package)
+            sample = "veles_tpu.znicz.samples." + spec
+            try:
+                return importlib.import_module(sample)
+            except ModuleNotFoundError as e:
+                if e.name != sample:
+                    raise  # a BROKEN sample must not be masked as absent
         return importlib.import_module(spec)
     path = os.path.abspath(spec)
     name = os.path.splitext(os.path.basename(path))[0]

@@ -13,6 +13,10 @@ import os
 import numpy
 
 _LIB_CANDIDATES = (
+    # explicit override first: a pip-installed package (site-packages)
+    # has no source tree to search relative to — deploy/Dockerfile sets
+    # this to its own build output
+    os.environ.get("VELES_NATIVE_LIB"),
     os.path.join(os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))),
         "native", "build", "libveles_native.so"),
