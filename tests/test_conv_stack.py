@@ -320,3 +320,41 @@ def test_fast_max_pool_grads_match_reduce_window_oracle():
             lambda v: (fast_max_pool(v, window, strides, pad, True)
                        * err).sum())(x)
         assert numpy.allclose(g_o, g_f, atol=1e-5), (window, strides)
+
+
+def test_max_pooling_separable_and_bf16_variants():
+    """Round-5 pooling experiments: separable is EXACT vs the 2-D
+    window (fwd and grads); bf16 matches to bf16 tolerance.  Overlapped
+    AlexNet geometry (k3 s2) on purpose."""
+    import jax
+    import jax.numpy as jnp
+    rng = numpy.random.RandomState(11)
+    x = rng.standard_normal((2, 15, 15, 8)).astype(numpy.float32)
+
+    def build(**kw):
+        wf = Workflow(name="pool-var")
+        u = MaxPooling(wf, kx=3, ky=3, sliding=(2, 2), **kw)
+        u.input = Array(x.copy())
+        u.initialize(device=Device(backend="cpu"))
+        return u
+
+    base = build()
+    sep = build(pool_separable=True)
+    bf16 = build(pool_bf16=True)
+    both = build(pool_separable=True, pool_bf16=True)
+    y0 = base.apply(None, jnp.asarray(x))
+    numpy.testing.assert_array_equal(
+        numpy.asarray(sep.apply(None, jnp.asarray(x))),
+        numpy.asarray(y0))
+    for v in (bf16, both):
+        out = numpy.asarray(v.apply(None, jnp.asarray(x)))
+        assert out.dtype == numpy.float32
+        numpy.testing.assert_allclose(out, numpy.asarray(y0),
+                                      rtol=1e-2, atol=1e-2)
+    # gradient parity: separable backward == select-and-scatter backward
+    g0 = jax.grad(lambda x: jnp.sum(base.apply(None, x) ** 2))(
+        jnp.asarray(x))
+    g1 = jax.grad(lambda x: jnp.sum(sep.apply(None, x) ** 2))(
+        jnp.asarray(x))
+    numpy.testing.assert_allclose(numpy.asarray(g1), numpy.asarray(g0),
+                                  rtol=1e-6, atol=1e-6)

@@ -1,0 +1,134 @@
+"""Round-5 on-chip interleaved A/Bs: pooling-region layout experiments
+and the rng_impl=rbg dropout lever, on the f32 epoch-scan AlexNet
+(VERDICT r4 items 2+3; docs/PERF.md ablation: max-pool machinery ~25 %,
+dropout ~4 % of the f32 step).
+
+Variants (each a knob combination, all parity-tested on CPU):
+  base      current defaults
+  sep       pool_separable: 2-D max window as two 1-D reduce_windows
+  bf16pool  pool_bf16: bf16 activations through the window + backward
+  sep+bf16  both
+  rbg       rng_impl=rbg (hardware RBG dropout masks vs threefry)
+  all       sep + bf16pool + rbg
+
+Interleaved, not sequential (round-4 lesson: contention drift inverts
+sequential same-process A/Bs): every repetition times each variant once,
+back-to-back; ratios use per-variant minima from the same window.
+
+Usage: python tools/ab_round5.py [variant ...]   (default: all of them)
+Prints one JSON line at the end.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy  # noqa: E402
+
+VARIANTS = {
+    "base": {},
+    "sep": {"pool_separable": True},
+    "bf16pool": {"pool_bf16": True},
+    "sep+bf16": {"pool_separable": True, "pool_bf16": True},
+    "rbg": {"rng_impl": "rbg"},
+    "all": {"pool_separable": True, "pool_bf16": True,
+            "rng_impl": "rbg"},
+}
+
+BATCH = int(os.environ.get("VELES_AB_BATCH", 128))
+SIDE = int(os.environ.get("VELES_AB_SIDE", 227))  # small for CPU smoke
+EPOCHS_PER_DISPATCH = 4   # half the bench's 8: shorter samples, more
+                          # interleave rounds per contention window
+REPEATS = int(os.environ.get("VELES_AB_REPEATS", 7))
+
+
+def _sync(step):
+    import jax
+    return float(numpy.asarray(
+        jax.tree_util.tree_leaves(step._params_)[0]).ravel()[0])
+
+
+def _build(knobs):
+    from veles_tpu.backends import Device
+    from veles_tpu.config import root
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.znicz.samples import alexnet
+
+    eng = root.common.engine
+    prior = {k: eng.get(k, None) for k in knobs}
+    eng.update(knobs)
+    try:
+        loader = {"minibatch_size": BATCH, "n_train": 8 * BATCH,
+                  "n_valid": BATCH, "prng": RandomGenerator().seed(3)}
+        if SIDE != 227:
+            loader["side"] = SIDE
+        wf = alexnet.create_workflow(
+            loader=loader,
+            decision={"max_epochs": 10 ** 9, "silent": True},
+            epoch_scan=True)
+        wf.initialize(device=Device(backend="auto"))
+        step = wf.fused_step
+        # compile + warm INSIDE the knob scope: rng_impl is read at
+        # trace time (znicz/fused.py:145)
+        step.train_epochs(EPOCHS_PER_DISPATCH)
+        step.train_epochs(EPOCHS_PER_DISPATCH)
+        _sync(step)
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                delattr(eng, k)
+            else:
+                setattr(eng, k, v)
+    return step
+
+
+def main(names):
+    t0 = time.perf_counter()
+    steps = {}
+    for name in names:
+        print("ab [%6.1fs] building %s" % (time.perf_counter() - t0,
+                                           name), file=sys.stderr,
+              flush=True)
+        steps[name] = _build(VARIANTS[name])
+    times = {n: [] for n in names}
+    images = 8 * BATCH * EPOCHS_PER_DISPATCH
+    for rep in range(REPEATS):
+        for name in names:           # interleaved: one sample each
+            step = steps[name]
+            t1 = time.perf_counter()
+            step.train_epochs(EPOCHS_PER_DISPATCH)
+            _sync(step)
+            times[name].append(time.perf_counter() - t1)
+        print("ab [%6.1fs] rep %d/%d done"
+              % (time.perf_counter() - t0, rep + 1, REPEATS),
+              file=sys.stderr, flush=True)
+    out = {"batch": BATCH, "epochs_per_dispatch": EPOCHS_PER_DISPATCH,
+           "repeats": REPEATS}
+    base_min = min(times["base"]) if "base" in times else None
+    for name in names:
+        tmin = min(times[name])
+        out[name] = {
+            "images_per_sec": round(images / tmin, 1),
+            "min_s": round(tmin, 4),
+            "median_s": round(sorted(times[name])[len(times[name]) // 2],
+                              4)}
+        if base_min and name != "base":
+            out[name]["speedup_vs_base"] = round(base_min / tmin, 3)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    unknown = [a for a in sys.argv[1:] if a not in VARIANTS]
+    if unknown:
+        # a typo must not silently burn a scarce quiet-chip window on
+        # the wrong variant set
+        raise SystemExit("unknown variant(s) %s; choose from %s"
+                         % (unknown, sorted(VARIANTS)))
+    names = sys.argv[1:] or list(VARIANTS)
+    if "base" not in names:
+        names.insert(0, "base")
+    main(names)

@@ -152,14 +152,57 @@ class PoolingBase(ParamlessForward):
 
 
 class MaxPooling(PoolingBase):
+    """Max pooling via ``lax.reduce_window``, plus two opt-in layout
+    experiments for the memory-bound pool region (round-5 hypotheses;
+    docs/PERF.md ablation: max-pool machinery ~25 % of the AlexNet f32
+    step):
+
+    - ``pool_separable``: the 2-D window as two 1-D reduce_windows
+      (rows then cols) — exact for max, reads ky+kx elements per output
+      instead of ky*kx, and the backward becomes two smaller
+      select-and-scatters (the first pass output is already
+      row-decimated);
+    - ``pool_bf16``: run the window (and therefore its backward select)
+      on bfloat16 activations — halves the HBM bytes of the dominant
+      pre-pool tensor; output upcast to the input dtype.  Numerics: max
+      VALUES round to bf16 (~3 decimal digits) and near-ties may pick a
+      different winner; opt-in only.
+
+    Both default to ``root.common.engine.pool_separable`` /
+    ``.pool_bf16`` (False) and compose."""
+
     MAPPING = "max_pooling"
     PAD_VALUE = -numpy.inf
 
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        from ..config import root
+        eng = root.common.engine
+        self.pool_separable = bool(kwargs.get(
+            "pool_separable", eng.get("pool_separable", False)))
+        self.pool_bf16 = bool(kwargs.get(
+            "pool_bf16", eng.get("pool_bf16", False)))
+
     def apply(self, params, x):
+        import jax.numpy as jnp
         from jax import lax
-        return lax.reduce_window(
-            x, -numpy.inf, lax.max, self._window_dims(),
-            self._window_strides(), self._window_padding())
+        dtype = x.dtype
+        if self.pool_bf16:
+            x = x.astype(jnp.bfloat16)
+        if self.pool_separable:
+            (pt, pb), (pl, pr) = self._window_padding()[1:3]
+            sy, sx = self.sliding
+            x = lax.reduce_window(
+                x, -numpy.inf, lax.max, (1, self.ky, 1, 1),
+                (1, sy, 1, 1), ((0, 0), (pt, pb), (0, 0), (0, 0)))
+            x = lax.reduce_window(
+                x, -numpy.inf, lax.max, (1, 1, self.kx, 1),
+                (1, 1, sx, 1), ((0, 0), (0, 0), (pl, pr), (0, 0)))
+        else:
+            x = lax.reduce_window(
+                x, -numpy.inf, lax.max, self._window_dims(),
+                self._window_strides(), self._window_padding())
+        return x.astype(dtype) if x.dtype != dtype else x
 
     def apply_numpy(self, params, x):
         out = numpy.empty(self.output_shape_for(x.shape), x.dtype)
