@@ -86,38 +86,59 @@ def _build(knobs):
     return step
 
 
+def _measure_pair(base_step, step, rep_tag, t0):
+    """REPEATS interleaved (base, variant) samples; returns both
+    min-lists.  Pairwise keeps at most TWO AlexNets resident (HBM: six
+    at once risks OOM on the shared 16 GB chip) and re-times base
+    inside every pair — per-pair drift insurance."""
+    tb, tv = [], []
+    for rep in range(REPEATS):
+        for s, acc in ((base_step, tb), (step, tv)):
+            t1 = time.perf_counter()
+            s.train_epochs(EPOCHS_PER_DISPATCH)
+            _sync(s)
+            acc.append(time.perf_counter() - t1)
+        print("ab [%6.1fs] %s rep %d/%d"
+              % (time.perf_counter() - t0, rep_tag, rep + 1, REPEATS),
+              file=sys.stderr, flush=True)
+    return tb, tv
+
+
 def main(names):
+    import gc
     t0 = time.perf_counter()
-    steps = {}
+    print("ab [%6.1fs] building base" % (time.perf_counter() - t0),
+          file=sys.stderr, flush=True)
+    base_step = _build(VARIANTS["base"])
+    images = 8 * BATCH * EPOCHS_PER_DISPATCH
+    out = {"batch": BATCH, "epochs_per_dispatch": EPOCHS_PER_DISPATCH,
+           "repeats": REPEATS}
+    base_all = []
+    if names == ["base"]:  # solo run: time base against itself
+        tb, _ = _measure_pair(base_step, base_step, "base", t0)
+        base_all += tb
     for name in names:
+        if name == "base":
+            continue
         print("ab [%6.1fs] building %s" % (time.perf_counter() - t0,
                                            name), file=sys.stderr,
               flush=True)
-        steps[name] = _build(VARIANTS[name])
-    times = {n: [] for n in names}
-    images = 8 * BATCH * EPOCHS_PER_DISPATCH
-    for rep in range(REPEATS):
-        for name in names:           # interleaved: one sample each
-            step = steps[name]
-            t1 = time.perf_counter()
-            step.train_epochs(EPOCHS_PER_DISPATCH)
-            _sync(step)
-            times[name].append(time.perf_counter() - t1)
-        print("ab [%6.1fs] rep %d/%d done"
-              % (time.perf_counter() - t0, rep + 1, REPEATS),
-              file=sys.stderr, flush=True)
-    out = {"batch": BATCH, "epochs_per_dispatch": EPOCHS_PER_DISPATCH,
-           "repeats": REPEATS}
-    base_min = min(times["base"]) if "base" in times else None
-    for name in names:
-        tmin = min(times[name])
+        step = _build(VARIANTS[name])
+        tb, tv = _measure_pair(base_step, step, name, t0)
+        base_all += tb
         out[name] = {
-            "images_per_sec": round(images / tmin, 1),
-            "min_s": round(tmin, 4),
-            "median_s": round(sorted(times[name])[len(times[name]) // 2],
-                              4)}
-        if base_min and name != "base":
-            out[name]["speedup_vs_base"] = round(base_min / tmin, 3)
+            "images_per_sec": round(images / min(tv), 1),
+            "min_s": round(min(tv), 4),
+            "median_s": round(sorted(tv)[len(tv) // 2], 4),
+            "pair_base_min_s": round(min(tb), 4),
+            "speedup_vs_base": round(min(tb) / min(tv), 3)}
+        del step
+        gc.collect()  # release this variant's HBM before the next
+    if base_all:
+        out["base"] = {
+            "images_per_sec": round(images / min(base_all), 1),
+            "min_s": round(min(base_all), 4),
+            "median_s": round(sorted(base_all)[len(base_all) // 2], 4)}
     print(json.dumps(out), flush=True)
 
 

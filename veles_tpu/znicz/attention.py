@@ -33,11 +33,12 @@ class MultiHeadAttention(ForwardBase):
         axis is given, attention runs as RING attention over it
         (sequence parallelism; parallel/ring.py) — the single-device
         math is identical;
-      use_pallas: route single-device attention through the flash
-        kernel pair (znicz/flash_attention.py — O(T*D) HBM traffic
-        instead of materialized [T, T] scores; defaults to
-        ``root.common.engine.use_pallas``).  The mesh/ring path above
-        takes precedence when both apply.
+      use_pallas: route attention through the Pallas flash kernels
+        (znicz/flash_attention.py — O(block) VMEM, no materialized
+        [T, T]; defaults to ``root.common.engine.use_pallas``).
+        Applies on BOTH paths: single-device flash attention, and ring
+        FLASH attention over the mesh (each hop's block math runs the
+        flash kernels, parallel/ring.py ring-flash custom VJP).
     """
 
     MAPPING = "multihead_attention"
@@ -103,7 +104,8 @@ class MultiHeadAttention(ForwardBase):
             return ring_attention(q, k, v, self.mesh,
                                   seq_axis=self.seq_axis,
                                   data_axis=self.data_axis,
-                                  causal=self.causal)
+                                  causal=self.causal,
+                                  use_pallas=self.use_pallas)
         if self.use_pallas:
             # the flash kernel pair: O(T*D) HBM traffic instead of the
             # oracle's materialized [T, T] scores (falls back to the

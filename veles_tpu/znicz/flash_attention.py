@@ -132,7 +132,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                          jnp.log(safe_l))[:, 0]
 
 
-def _flash_fwd_bh(q, k, v, scale, causal, block_q, block_k):
+def _struct(shape, dtype, vma):
+    """ShapeDtypeStruct, with mesh-variance declared when the kernel
+    runs inside a shard_map (ring flash attention) — check_vma requires
+    pallas outputs to state their varying axes."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+
+
+def _flash_fwd_bh(q, k, v, scale, causal, block_q, block_k, vma=None):
     """Forward over [BH, T, D] operands; returns (out, lse[BH, T])."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -149,8 +158,8 @@ def _flash_fwd_bh(q, k, v, scale, causal, block_q, block_k):
         in_specs=[qspec, kspec, kspec],
         out_specs=[qspec,
                    pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))],
-        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-                   jax.ShapeDtypeStruct((bh, t), jnp.float32)],
+        out_shape=[_struct((bh, t, d), q.dtype, vma),
+                   _struct((bh, t), jnp.float32, vma)],
         scratch_shapes=[pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, d), jnp.float32)],
@@ -242,7 +251,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_bh(q, k, v, out, lse, do, scale, causal, block_q,
-                  block_k):
+                  block_k, vma=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -260,7 +269,7 @@ def _flash_bwd_bh(q, k, v, out, lse, do, scale, causal, block_q,
         grid=(bh, n_q, n_k),
         in_specs=[qspec, kspec, kspec, qspec, qrow, qrow],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_shape=_struct((bh, t, d), q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret())(q, k, v, do, lse, delta)
     # dk/dv pass: K block pinned per middle-grid step, Q streams inner
@@ -273,8 +282,8 @@ def _flash_bwd_bh(q, k, v, out, lse, do, scale, causal, block_q,
         grid=(bh, n_k, n_q),
         in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, kq_row, kq_row],
         out_specs=[kk_spec, kk_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, t, d), v.dtype)],
+        out_shape=[_struct((bh, t, d), k.dtype, vma),
+                   _struct((bh, t, d), v.dtype, vma)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=_interpret())(q, k, v, do, lse, delta)

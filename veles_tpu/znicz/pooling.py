@@ -217,14 +217,30 @@ class AvgPooling(PoolingBase):
     def apply(self, params, x):
         import jax.numpy as jnp
         from jax import lax
-        ones = jnp.ones_like(x)
         s = lax.reduce_window(x, 0.0, lax.add, self._window_dims(),
                               self._window_strides(),
                               self._window_padding())
-        n = lax.reduce_window(ones, 0.0, lax.add, self._window_dims(),
-                              self._window_strides(),
-                              self._window_padding())
-        return s / n
+        # the in-bounds count per window is pure geometry — computing
+        # it as reduce_window(ones) made XLA constant-fold a full-size
+        # windowed reduction at COMPILE time (observed 45+ s of
+        # slow_operation_alarm per stl10 compile); numpy at trace time
+        # produces the same [1, oh, ow, 1] constant for free
+        return s / jnp.asarray(self._window_counts(x.shape), x.dtype)
+
+    def _window_counts(self, xshape):
+        _, h, w, _ = xshape
+        key = (h, w, self.ky, self.kx, self.sliding, self.padding)
+        cached = getattr(self, "_counts_cache_", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        ones = numpy.ones((1, h, w, 1), numpy.float32)
+        counts = numpy.empty(
+            (1,) + self.output_shape_for((1, h, w, 1))[1:3] + (1,),
+            numpy.float32)
+        for i, j, win in self.numpy_windows(ones):
+            counts[:, i, j, :] = win.sum(axis=(1, 2))
+        self._counts_cache_ = (key, counts)
+        return counts
 
     def apply_numpy(self, params, x):
         """Divides by the count of in-bounds elements per window (matching
