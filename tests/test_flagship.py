@@ -122,3 +122,22 @@ def test_flagship_seq_axis_trains():
         val, params = step(params)
         losses.append(float(val))
     assert losses[-1] < 0.7 * losses[0], (losses[0], losses[-1])
+
+
+def test_flagship_seq_axis_with_ring_flash_matches_oracle():
+    """The 4-axis composition with use_pallas=True: each stage's
+    attention runs as ring FLASH attention (per-hop Pallas kernels,
+    parallel/ring.py) — equals the global-attention oracle.  T=64 over
+    sp=2 gives 32-row local chunks, the flash tile minimum."""
+    from veles_tpu.parallel.mesh import make_mesh
+    params = init_params(stages=S, experts=E, seed=11)
+    rng = numpy.random.RandomState(12)
+    x = jnp.asarray(rng.standard_normal((2, 64, 16)) * 0.5, jnp.float32)
+    mesh = make_mesh({"data": 1, "seq": 2, "pipe": 2, "expert": 2})
+    y = flagship_apply(params, x, mesh, microbatches=2, seq_axis="seq",
+                       use_pallas=True)
+    ref = flagship_reference(params, x, microbatches=2, data_shards=1,
+                             seq_shards=2)
+    assert numpy.allclose(numpy.asarray(y), numpy.asarray(ref),
+                          atol=2e-4), numpy.abs(
+        numpy.asarray(y) - numpy.asarray(ref)).max()

@@ -68,7 +68,8 @@ def _expert_ffn(p, h):
     return jnp.maximum(h @ p["w1"], 0.0) @ p["w2"]
 
 
-def _attend_block(params, h, heads, seq_axis=None, vary_axes=None):
+def _attend_block(params, h, heads, seq_axis=None, vary_axes=None,
+                  use_pallas=False):
     b, t, d = h.shape
     qkv = _rmsnorm(h) @ params["qkv"]
     q, k, v = (qkv[..., i * d:(i + 1) * d].reshape(b, t, heads,
@@ -78,21 +79,29 @@ def _attend_block(params, h, heads, seq_axis=None, vary_axes=None):
         a = attention_reference(q, k, v, causal=True)
     else:
         # inside the full-mesh shard_map: t is this shard's chunk and
-        # the K/V blocks ride the seq ring (flash recurrence)
-        a = _ring_attention_local(
+        # the K/V blocks ride the seq ring (flash recurrence);
+        # use_pallas swaps in ring FLASH attention (per-hop Pallas
+        # kernels, parallel/ring.py) when the chunk tiles
+        local = _ring_attention_local
+        if use_pallas:
+            from ...parallel.ring import _ring_flash_local
+            from ..flash_attention import flash_attention_supported
+            if flash_attention_supported(t):
+                local = _ring_flash_local
+        a = local(
             q, k, v, axis_name=seq_axis, causal=True,
             scale=1.0 / math.sqrt(d // heads), vary_axes=vary_axes)
     return h + a.reshape(b, t, d) @ params["proj"]
 
 
 def _block_sharded(params, h, *, heads, capacity, k, seq_axis=None,
-                   vary_axes=None):
+                   vary_axes=None, use_pallas=False):
     """One transformer block INSIDE the full-mesh shard_map: expert
     leaves carry a leading local-expert dim (1), the MoE dispatch
     psums over the bound ``expert`` axis, and (when ``seq_axis`` is
     bound) attention rides the seq ring."""
     h = _attend_block(params, h, heads, seq_axis=seq_axis,
-                      vary_axes=vary_axes)
+                      vary_axes=vary_axes, use_pallas=use_pallas)
     b, t, d = h.shape
     flat = _rmsnorm(h).reshape(b * t, d)
     moe = _moe_local({"w1": params["w1"], "w2": params["w2"]},
@@ -122,7 +131,8 @@ def _block_oracle(params, h, *, heads, capacity, k, seq_shards=1):
 
 
 def flagship_apply(params, x, mesh, heads=2, microbatches=None,
-                   capacity_factor=2.0, k=1, seq_axis=None):
+                   capacity_factor=2.0, k=1, seq_axis=None,
+                   use_pallas=False):
     """The pipelined sharded forward: x [B, T, D] with B over ``data``,
     blocks over ``pipe``, experts over ``expert`` — and T over
     ``seq_axis`` when given (ring attention inside each stage)."""
@@ -139,7 +149,8 @@ def flagship_apply(params, x, mesh, heads=2, microbatches=None,
                  if a and a in mesh.shape) + ("pipe",)
     block = functools.partial(_block_sharded, heads=heads,
                               capacity=capacity, k=k,
-                              seq_axis=seq_axis, vary_axes=vary)
+                              seq_axis=seq_axis, vary_axes=vary,
+                              use_pallas=use_pallas)
     specs = {"qkv": P("pipe"), "proj": P("pipe"), "wr": P("pipe"),
              "w1": P("pipe", "expert"), "w2": P("pipe", "expert")}
     x_spec = P("data", seq_axis) if seq_axis else P("data")
