@@ -172,3 +172,47 @@ def test_real_mnist_tier_engages_when_files_present(tmp_path):
             del root.common.dirs.datasets
         else:
             root.common.dirs.datasets = prior
+
+
+def test_real_cifar_tier_engages_when_batches_present(tmp_path):
+    """Like the MNIST tier test: the day real cifar-10-batches-py files
+    land in the datasets dir, the CIFAR sample trains on them — proven
+    by staging format-correct pickle batches and watching provenance
+    flip to "real"."""
+    import pickle
+    import numpy
+    from veles_tpu.config import root
+    from veles_tpu.znicz.samples import cifar
+
+    d = tmp_path / "datasets" / "cifar-10-batches-py"
+    d.mkdir(parents=True)
+    rng = numpy.random.RandomState(0)
+    for name, n in [("data_batch_%d" % i, 20) for i in range(1, 6)] + \
+                   [("test_batch", 30)]:
+        with open(d / name, "wb") as f:
+            pickle.dump({b"data": rng.randint(
+                0, 256, (n, 3072), dtype=numpy.uint8),
+                b"labels": [int(x) for x in rng.randint(0, 10, n)]}, f)
+    prior = root.common.dirs.get("datasets", None)
+    root.common.dirs.datasets = str(tmp_path / "datasets")
+    try:
+        wf = cifar.create_workflow(
+            loader={"minibatch_size": 10, "n_train": 40, "n_valid": 20,
+                    "prng": RandomGenerator().seed(3)},
+            decision={"max_epochs": 1, "silent": True})
+        wf.initialize(device=Device(backend="cpu"))
+        assert wf.loader.provenance == "real"
+        assert wf.loader.original_data.shape == (60, 32, 32, 3)
+        # and the synthetic twin still reports itself honestly
+        del root.common.dirs.datasets
+        wf2 = cifar.create_workflow(
+            loader={"minibatch_size": 10, "n_train": 40, "n_valid": 20,
+                    "prng": RandomGenerator().seed(3)},
+            decision={"max_epochs": 1, "silent": True})
+        wf2.initialize(device=Device(backend="cpu"))
+        assert wf2.loader.provenance == "synthetic"
+    finally:
+        if prior is None:
+            root.common.dirs.pop("datasets", None)
+        else:
+            root.common.dirs.datasets = prior

@@ -84,12 +84,16 @@ class CifarLoader(FullBatchLoader):
     def __init__(self, workflow, **kwargs):
         self.n_train = kwargs.pop("n_train", None)
         self.n_valid = kwargs.pop("n_valid", None)
+        #: "real" when the on-disk CIFAR-10 batches were used,
+        #: "synthetic" for the twin (same contract as the MNIST loader)
+        self.provenance = None
         super().__init__(workflow, **kwargs)
 
     def load_data(self):
         d = os.path.join(os.path.expanduser(
             root.common.dirs.get("datasets", "")), "cifar-10-batches-py")
         if os.path.isdir(d):
+            self.provenance = "real"
             imgs, labels = [], []
             for name in ["data_batch_%d" % i for i in range(1, 6)]:
                 with open(os.path.join(d, name), "rb") as f:
@@ -106,6 +110,7 @@ class CifarLoader(FullBatchLoader):
             ti, tl = ti[:self.n_train], tl[:self.n_train]
             vi, vl = vi[:self.n_valid], vl[:self.n_valid]
         else:
+            self.provenance = "synthetic"
             (ti, tl), (vi, vl) = _synthetic_cifar(
                 self.n_train or 5000, self.n_valid or 1000)
         data = numpy.concatenate([vi, ti]).astype(numpy.float32)
