@@ -229,15 +229,24 @@ def _ring_flash_local(q, k, v, axis_name, causal, scale,
     def inner_bwd(res, g):
         from ..znicz.flash_attention import (DEFAULT_BLOCK_K,
                                              DEFAULT_BLOCK_Q,
-                                             _blocks, _flash_bwd_bh,
-                                             _from_bh, _to_bh)
+                                             _STAT_LANES, _blocks,
+                                             _flash_bwd_bh, _from_bh,
+                                             _to_bh)
         q, k, v, out, lse = res
         n_dev = lax.psum(1, axis_name)
         my_idx = lax.axis_index(axis_name)
         b, t_local, h, d = q.shape
         bq, bk = _blocks(t_local, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
         q_bh, out_bh, g_bh = _to_bh(q), _to_bh(out), _to_bh(g)
-        lse_bh = lse.reshape(b * h, t_local)
+        # lse/delta are hop-invariant: lane-broadcast them ONCE here,
+        # not inside every hop's _flash_bwd_bh call
+        lse_bh = jnp.broadcast_to(
+            lse.reshape(b * h, t_local)[..., None],
+            (b * h, t_local, _STAT_LANES))
+        delta_bh = jnp.broadcast_to(
+            jnp.sum(g_bh.astype(jnp.float32) *
+                    out_bh.astype(jnp.float32), axis=-1)[..., None],
+            (b * h, t_local, _STAT_LANES))
 
         vma = frozenset(vary_axes or (axis_name,))
 
@@ -245,7 +254,8 @@ def _ring_flash_local(q, k, v, axis_name, causal, scale,
             def run(k_blk, v_blk):
                 dq_bh, dk_bh, dv_bh = _flash_bwd_bh(
                     q_bh, _to_bh(k_blk), _to_bh(v_blk), out_bh, lse_bh,
-                    g_bh, scale, causal_flag, bq, bk, vma=vma)
+                    g_bh, scale, causal_flag, bq, bk, vma=vma,
+                    delta=delta_bh)
                 return (_from_bh(dq_bh, b, h).astype(jnp.float32),
                         _from_bh(dk_bh, b, h).astype(jnp.float32),
                         _from_bh(dv_bh, b, h).astype(jnp.float32))

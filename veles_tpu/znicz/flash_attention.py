@@ -45,6 +45,12 @@ def _interpret():
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 _MIN_BLOCK = 32         # >= f32 sublane tile; smallest worthwhile tile
+_STAT_LANES = 128       # per-row stats (lse, delta) ride a full lane
+                        # dim: Mosaic requires block last-dims (8, 128)
+                        # tileable, so a [BH, T] row vector can't be
+                        # blocked (1, block_q) — broadcast across 128
+                        # lanes at the kernel boundary instead (the
+                        # canonical TPU flash layout)
 _NEG_INF = float("-inf")
 _warned_shapes = set()
 
@@ -128,8 +134,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         m, l = m_scr[...], l_scr[...]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
-        lse_ref[0, :] = (jnp.where(jnp.isneginf(m), 0.0, m) +
-                         jnp.log(safe_l))[:, 0]
+        lse = jnp.where(jnp.isneginf(m), 0.0, m) + jnp.log(safe_l)
+        lse_ref[0] = jnp.broadcast_to(lse, (block_q, _STAT_LANES))
 
 
 def _struct(shape, dtype, vma):
@@ -153,17 +159,19 @@ def _flash_fwd_bh(q, k, v, scale, causal, block_q, block_k, vma=None):
         block_k=block_k)
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
-    return pl.pallas_call(
+    qrow = pl.BlockSpec((1, block_q, _STAT_LANES),
+                        lambda b, i, j: (b, i, 0))
+    out, lse = pl.pallas_call(
         kernel, grid=(bh, n_q, n_k),
         in_specs=[qspec, kspec, kspec],
-        out_specs=[qspec,
-                   pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))],
+        out_specs=[qspec, qrow],
         out_shape=[_struct((bh, t, d), q.dtype, vma),
-                   _struct((bh, t), jnp.float32, vma)],
+                   _struct((bh, t, _STAT_LANES), jnp.float32, vma)],
         scratch_shapes=[pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret())(q, k, v)
+    return out, lse[:, :, 0]
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -182,8 +190,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _step():
         q = q_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, :][:, None]
-        delta = delta_ref[0, :][:, None]
+        lse = lse_ref[0, :, 0:1]
+        delta = delta_ref[0, :, 0:1]
         kb = k_ref[0].astype(jnp.float32)
         vb = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
@@ -225,8 +233,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         vb = v_ref[0].astype(jnp.float32)
         q = q_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, :][:, None]
-        delta = delta_ref[0, :][:, None]
+        lse = lse_ref[0, :, 0:1]
+        delta = delta_ref[0, :, 0:1]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -251,17 +259,28 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_bh(q, k, v, out, lse, do, scale, causal, block_q,
-                  block_k, vma=None):
+                  block_k, vma=None, delta=None):
+    """lse (and the optional precomputed delta) may arrive either as
+    [BH, T] rows or already lane-broadcast [BH, T, _STAT_LANES] — the
+    ring backward hoists the broadcast out of its per-hop loop."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t, d = q.shape
     n_q, n_k = t // block_q, t // block_k
-    # delta_i = sum_d do*out — tiny elementwise reduce; XLA fuses it
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                           # [BH, T]
+    if delta is None:
+        # delta_i = sum_d do*out — tiny elementwise reduce; XLA fuses it
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)                       # [BH, T]
+    # stats enter the kernels lane-broadcast (see _STAT_LANES)
+    if delta.ndim == 2:
+        delta = jnp.broadcast_to(delta[..., None],
+                                 (bh, t, _STAT_LANES))
+    if lse.ndim == 2:
+        lse = jnp.broadcast_to(lse[..., None], (bh, t, _STAT_LANES))
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    qrow = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    qrow = pl.BlockSpec((1, block_q, _STAT_LANES),
+                        lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -274,7 +293,8 @@ def _flash_bwd_bh(q, k, v, out, lse, do, scale, causal, block_q,
         interpret=_interpret())(q, k, v, do, lse, delta)
     # dk/dv pass: K block pinned per middle-grid step, Q streams inner
     kq_spec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
-    kq_row = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    kq_row = pl.BlockSpec((1, block_q, _STAT_LANES),
+                          lambda b, j, i: (b, i, 0))
     kk_spec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
