@@ -30,6 +30,9 @@ Also reported in the same JSON line:
   the hand-kernel delta on the real chip once per round; round-4
   measurement: the gridded kernel compiles in ~18 s but the pallas_call
   boundary blocks XLA fusion, so the pure-XLA MXU path stays default).
+- ``flash_attention_speedup`` — train-shaped (fwd+bwd) Pallas flash
+  attention vs the XLA oracle at B2 T2048 H8 D64, interleaved — the
+  hand-kernel-beats-XLA delta, recorded on the real chip each round.
 - ``precise_gemm`` — on-chip cost of the compensated GEMM levels
   ({l0_tflops, l1_overhead, l2_overhead, l0_vs_xla_default}); the
   reference charged +9 %/+90 % for levels 1/2, on the MXU the block
@@ -403,6 +406,45 @@ def bench_precise_gemm(n=4096, reps=8, repeats=6):
     }
 
 
+def bench_flash_attention(b=2, t=2048, h=8, d=64, reps=8, chain=4):
+    """Train-shaped (full fwd+bwd, grads wrt q/k/v on both sides — see
+    tools.ab_flash_attention.train_shaped for the DCE-fairness
+    rationale) interleaved A/B: the Pallas flash kernel pair vs the
+    XLA oracle that materializes [B, H, T, T]
+    (znicz/flash_attention.py vs parallel/ring.py:27) — records the
+    hand-kernel-beats-XLA delta on the real chip each round (round-5
+    measurement: train 1.03-1.14x at T=1k-4k, largest at longest T;
+    fwd-only and other windows in docs/PERF.md).  ``chain`` dependent
+    steps per dispatch amortize the tunnel RTT."""
+    import numpy
+    import jax.numpy as jnp
+    from tools.ab_flash_attention import train_shaped
+    from veles_tpu.parallel.ring import attention_reference
+    from veles_tpu.znicz.flash_attention import flash_attention
+    _stamp("flash-attention stage")
+    rng = numpy.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)) * 0.5,
+                           jnp.float32) for _ in range(3))
+
+    fa = train_shaped(lambda q, k, v: flash_attention(q, k, v, True),
+                      chain)
+    fo = train_shaped(lambda q, k, v: attention_reference(
+        q, k, v, causal=True), chain)
+    ta, to = [], []
+    for f in (fa, fo):
+        numpy.asarray(f(q, k, v)[0])[0, 0]  # compile + flush
+    for _ in range(reps):
+        for f, acc in ((fa, ta), (fo, to)):
+            t0 = time.perf_counter()
+            numpy.asarray(f(q, k, v)[0])[0, 0]
+            acc.append((time.perf_counter() - t0) / chain)
+    _record("flash_train", ta)
+    _record("attn_oracle_train", to)
+    return {"flash_attention_train_s": round(min(ta), 5),
+            "attention_oracle_train_s": round(min(to), 5),
+            "flash_attention_shape": [b, t, h, d]}
+
+
 def bench_liveness():
     """Stage 0 gate: one tiny jitted matmul with a real D2H flush.  If
     THIS can't finish, the tunnel is down and the orchestrator reports
@@ -438,6 +480,8 @@ def _stage_main(stage):
                "flops_source": flops_source}
     elif stage == "mnist":
         out = {"mnist_anchor_images_per_sec": round(bench_mnist(), 1)}
+    elif stage == "flash_attention":
+        out = bench_flash_attention()
     elif stage == "pallas_lrn":
         ips = bench_alexnet_scan(batch=BATCH, use_pallas_lrn=True,
                                  repeats=3, name="alexnet_pallas_lrn")
@@ -463,6 +507,7 @@ STAGE_PLAN = [
     ("alexnet_bf16", 900),
     ("alexnet_step", 600),
     ("mnist", 600),
+    ("flash_attention", 240),
     ("pallas_lrn", 300),
     ("precise_gemm", 300),
 ]
@@ -539,6 +584,10 @@ def _orchestrate():
     lrn_ips = line.get("pallas_lrn_images_per_sec")
     if lrn_ips and scan_ips:
         line["pallas_lrn_speedup"] = round(lrn_ips / scan_ips, 3)
+    fl, orc = (line.get("flash_attention_train_s"),
+               line.get("attention_oracle_train_s"))
+    if fl and orc:
+        line["flash_attention_speedup"] = round(orc / fl, 3)
     if errors:
         line["stage_errors"] = errors
     line["spread"] = SPREAD

@@ -1,0 +1,2 @@
+"""Dev/bench tooling (fixture writers, on-chip A/Bs).  A package so
+bench.py and the tools can share measurement harness code."""

@@ -31,6 +31,29 @@ def _sync(x):
     return float(numpy.asarray(jax.tree_util.tree_leaves(x)[0]).ravel()[0])
 
 
+def train_shaped(attend, chain):
+    """Jitted full train step xchain: grads wrt ALL THREE operands —
+    grad wrt q alone would let XLA dead-code-eliminate an oracle's
+    dK/dV matmuls while a flash custom-VJP kernel computes all three
+    (asymmetric A/B); all three updates are jit outputs so the LAST
+    iteration's dK/dV work can't be eliminated either.  Shared by
+    bench.py's flash_attention stage and tools/longcontext_demo.py —
+    the recorded metric and the tool that validated it must not
+    diverge."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss(q, k, v):
+        return jnp.sum(attend(q, k, v) ** 2)
+
+    def run(q, k, v):
+        for _ in range(chain):
+            gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            q, k, v = q - 1e-3 * gq, k - 1e-3 * gk, v - 1e-3 * gv
+        return q, k, v
+    return jax.jit(run)
+
+
 def _time_pair(fa, fb, args, reps=12, chain=4):
     """min-of-reps for two fns, interleaved; ``chain`` dependent calls
     per dispatch amortize the ~14 ms tunnel RTT."""
@@ -57,23 +80,12 @@ def ab_shape(b, t, h, d, causal=True, chain=4):
             return out
         return jax.jit(run)
 
-    def train_shaped(attend):
-        def loss(q, k, v):
-            return jnp.sum(attend(q, k, v) ** 2)
-
-        def run(q, k, v):
-            out = q
-            for _ in range(chain):
-                g = jax.grad(loss)(out, k, v)
-                out = out - 1e-3 * g
-            return out
-        return jax.jit(run)
-
     flash = lambda q, k, v: flash_attention(q, k, v, causal)  # noqa: E731
     oracle = lambda q, k, v: attention_reference(  # noqa: E731
         q, k, v, causal=causal)
     res = {"shape": [b, t, h, d], "causal": causal}
-    for tag, wrap in (("fwd", chained), ("train", train_shaped)):
+    for tag, wrap in (("fwd", chained),
+                      ("train", lambda f: train_shaped(f, chain))):
         fa, fb = wrap(flash), wrap(oracle)
         _sync(fa(q, k, v))  # compile
         _sync(fb(q, k, v))
