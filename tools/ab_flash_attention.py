@@ -54,9 +54,17 @@ def train_shaped(attend, chain):
     return jax.jit(run)
 
 
-def _time_pair(fa, fb, args, reps=12, chain=4):
-    """min-of-reps for two fns, interleaved; ``chain`` dependent calls
-    per dispatch amortize the ~14 ms tunnel RTT."""
+def time_pair(fa, fb, args, reps=12, chain=4):
+    """Interleaved A/B timing discipline (round-4 lesson: contention
+    drift inverts sequential comparisons): compile+warm both fns, then
+    each repetition times A then B back-to-back; ``chain`` dependent
+    calls per dispatch amortize the ~14 ms tunnel RTT.  Returns the
+    full per-rep second lists (callers take min/median/spread).
+    Shared by this tool and bench.py's flash_attention stage — the
+    recorded metric and the tool that validated it must not
+    diverge."""
+    for fn in (fa, fb):
+        _sync(fn(*args))
     ta, tb = [], []
     for _ in range(reps):
         for fn, acc in ((fa, ta), (fb, tb)):
@@ -64,7 +72,7 @@ def _time_pair(fa, fb, args, reps=12, chain=4):
             out = fn(*args)
             _sync(out)
             acc.append((time.perf_counter() - t0) / chain)
-    return min(ta), min(tb)
+    return ta, tb
 
 
 def ab_shape(b, t, h, d, causal=True, chain=4):
@@ -87,9 +95,8 @@ def ab_shape(b, t, h, d, causal=True, chain=4):
     for tag, wrap in (("fwd", chained),
                       ("train", lambda f: train_shaped(f, chain))):
         fa, fb = wrap(flash), wrap(oracle)
-        _sync(fa(q, k, v))  # compile
-        _sync(fb(q, k, v))
-        a, b_ = _time_pair(fa, fb, (q, k, v), chain=chain)
+        ta, tb = time_pair(fa, fb, (q, k, v), chain=chain)
+        a, b_ = min(ta), min(tb)
         res.update({tag + "_flash_s": round(a, 5),
                     tag + "_xla_s": round(b_, 5),
                     tag + "_speedup": round(b_ / a, 3)})
