@@ -141,3 +141,17 @@ def test_flagship_seq_axis_with_ring_flash_matches_oracle():
     assert numpy.allclose(numpy.asarray(y), numpy.asarray(ref),
                           atol=2e-4), numpy.abs(
         numpy.asarray(y) - numpy.asarray(ref)).max()
+
+
+def test_flagship_rejects_mesh_param_mismatch():
+    """Stacked params larger than the mesh axes must fail loudly, not
+    silently run stage 0 / expert 0 (the bench once recorded a 4x
+    inflated number this way)."""
+    import pytest
+    from jax.sharding import Mesh
+    params = init_params(stages=4, experts=4)
+    x, _ = _data()
+    dev = numpy.asarray(jax.devices()[:1]).reshape(1, 1, 1)
+    mesh = Mesh(dev, ("data", "pipe", "expert"))
+    with pytest.raises(ValueError, match="must match"):
+        flagship_apply(params, x, mesh, microbatches=2)

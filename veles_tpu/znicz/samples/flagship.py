@@ -139,6 +139,18 @@ def flagship_apply(params, x, mesh, heads=2, microbatches=None,
     from jax.sharding import PartitionSpec as P
     s = mesh.shape["pipe"]
     e = mesh.shape["expert"]
+    # the pipeline shard takes p[0] of ITS slice and the MoE shard
+    # routes to ITS local experts: stacked params larger than the mesh
+    # axes would silently truncate to stage 0 / expert 0 (a 1-device
+    # mesh once inflated a bench 4x this way) — fail loudly instead
+    got_s = jax.tree_util.tree_leaves(params)[0].shape[0]
+    got_e = params["w1"].shape[1]
+    if got_s != s or got_e != e:
+        raise ValueError(
+            "flagship params are stacked for %d stages x %d experts "
+            "but the mesh has pipe=%d x expert=%d — sizes must match "
+            "(a mismatch would silently run a truncated model)"
+            % (got_s, got_e, s, e))
     dp = mesh.shape.get("data", 1)
     sp = mesh.shape.get(seq_axis, 1) if seq_axis else 1
     m = microbatches if microbatches is not None else 2 * s
