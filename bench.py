@@ -185,8 +185,12 @@ def _make_alexnet(batch, compute_dtype=None, epoch_scan=False,
     from veles_tpu.prng import RandomGenerator
     from veles_tpu.znicz.samples import alexnet
 
-    prior = root.common.engine.get("use_pallas", False)
-    root.common.engine.use_pallas = bool(use_pallas_lrn)
+    # restore UNSET as unset: the knob is tri-state (None = per-unit
+    # AUTO, nn_units.resolve_use_pallas) — writing False back would
+    # force-off attention AUTO for the rest of the process
+    prior = root.common.engine.get("use_pallas", None)
+    if use_pallas_lrn:
+        root.common.engine.use_pallas = True
     try:
         trainer = {"compute_dtype": compute_dtype} if compute_dtype else {}
         wf = alexnet.create_workflow(
@@ -196,7 +200,11 @@ def _make_alexnet(batch, compute_dtype=None, epoch_scan=False,
             trainer=trainer, epoch_scan=epoch_scan)
         wf.initialize(device=Device(backend="auto"))
     finally:
-        root.common.engine.use_pallas = prior
+        if prior is None:
+            if use_pallas_lrn:
+                delattr(root.common.engine, "use_pallas")
+        else:
+            root.common.engine.use_pallas = prior
     return wf
 
 

@@ -93,3 +93,43 @@ def test_mha_unit_use_pallas_knob():
         outs[use_pallas] = numpy.asarray(unit.output.map_read())
     numpy.testing.assert_allclose(outs[True], outs[False],
                                   rtol=2e-5, atol=2e-5)
+
+
+def test_use_pallas_auto_default():
+    """Unset use_pallas is AUTO: oracle on CPU (interpret kernels are
+    slow), flash on TPU — resolved at run time, not construction."""
+    from veles_tpu.config import root
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.znicz.attention import MultiHeadAttention
+    assert root.common.engine.get("use_pallas", None) is None
+    wf = Workflow(name="auto")
+    unit = MultiHeadAttention(wf, heads=2)
+    assert unit.use_pallas is None
+    assert unit._resolved_use_pallas() is False  # suite runs on CPU
+    unit_forced = MultiHeadAttention(wf, heads=2, use_pallas=True)
+    assert unit_forced._resolved_use_pallas() is True
+
+
+def test_resolve_use_pallas_semantics():
+    """Shared tri-state knob: force wins, AUTO is per-unit measured
+    best on the unit's OWN device (not the process default), and
+    oracle_only (the export guard) overrides everything."""
+    from veles_tpu.backends import Device
+    from veles_tpu.znicz.nn_units import oracle_only, resolve_use_pallas
+
+    cpu_dev = Device(backend="cpu")
+
+    class FakeTPU:
+        BACKEND = "tpu"
+
+    assert resolve_use_pallas(True, cpu_dev, tpu_auto=True) is True
+    assert resolve_use_pallas(False, FakeTPU(), tpu_auto=True) is False
+    # AUTO keyed off the unit's device, not jax.default_backend()
+    assert resolve_use_pallas(None, FakeTPU(), tpu_auto=True) is True
+    assert resolve_use_pallas(None, cpu_dev, tpu_auto=True) is False
+    # LRN-style units (measured loss) never auto-enable
+    assert resolve_use_pallas(None, FakeTPU(), tpu_auto=False) is False
+    # the export guard forces the pure-XLA path even when forced on
+    with oracle_only():
+        assert resolve_use_pallas(True, FakeTPU(), tpu_auto=True) is False
+    assert resolve_use_pallas(True, FakeTPU(), tpu_auto=True) is True

@@ -137,8 +137,11 @@ def make_parser():
                    help="config overrides")
     p.add_argument("-s", "--snapshot", default=None,
                    help="resume from a snapshot file")
-    p.add_argument("--random-seed", type=int, default=None,
-                   help="seed for the deterministic PRNG tree")
+    p.add_argument("--random-seed", type=str, default=None,
+                   metavar="N|0xHEX|PATH:NBYTES",
+                   help="seed for the deterministic PRNG tree "
+                        "(decimal, hex, or NBYTES read from PATH, "
+                        "e.g. /dev/urandom:16 — see parse_seed)")
     p.add_argument("-a", "--backend", default=None,
                    choices=("auto", "tpu", "cpu", "numpy"),
                    help="compute backend (default: config)")

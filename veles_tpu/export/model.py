@@ -52,8 +52,13 @@ def export_forward(workflow, batch="b"):
     x_struct = jax.ShapeDtypeStruct(dims, dtype)
     params_struct = jax.tree_util.tree_map(
         lambda a: jax.ShapeDtypeStruct(numpy.shape(a), a.dtype), params)
-    exported = jexport.export(jax.jit(forward_fn(forwards)))(
-        params_struct, x_struct)
+    # trace with every Pallas-capable unit on its pure-XLA path: a
+    # Mosaic tpu_custom_call baked into the artifact would break the
+    # package's any-backend portability (loader.py, native runtime)
+    from ..znicz.nn_units import oracle_only
+    with oracle_only():
+        exported = jexport.export(jax.jit(forward_fn(forwards)))(
+            params_struct, x_struct)
     metadata = {
         "format": "jax.export/stablehlo",
         "input": {"sample_shape": list(sample_shape),

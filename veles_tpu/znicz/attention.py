@@ -33,7 +33,11 @@ class MultiHeadAttention(ForwardBase):
         axis is given, attention runs as RING attention over it
         (sequence parallelism; parallel/ring.py) — the single-device
         math is identical;
-      use_pallas: route attention through the Pallas flash kernels
+      use_pallas: tri-state.  True/False force; unset (None) = AUTO:
+        flash kernels whenever running on TPU (measured >= parity fwd
+        and ahead on train steps, docs/PERF.md), the jnp oracle on
+        CPU (interpret-mode kernels are orders slower).  Route
+        attention through the Pallas flash kernels
         (znicz/flash_attention.py — O(block) VMEM, no materialized
         [T, T]; defaults to ``root.common.engine.use_pallas``).
         Applies on BOTH paths: single-device flash attention, and ring
@@ -51,8 +55,13 @@ class MultiHeadAttention(ForwardBase):
         self.seq_axis = kwargs.get("seq_axis", "seq")
         self.data_axis = kwargs.get("data_axis")
         from ..config import root
-        self.use_pallas = bool(kwargs.get(
-            "use_pallas", root.common.engine.get("use_pallas", False)))
+        # tri-state: True / False force; None (the default) = AUTO —
+        # flash kernels on TPU where they measure >= parity (fwd) and
+        # ahead (train), the jnp oracle elsewhere (CPU interpret mode
+        # of the kernel is orders slower); docs/PERF.md round-5 A/Bs
+        up = kwargs.get("use_pallas",
+                        root.common.engine.get("use_pallas", None))
+        self.use_pallas = up if up is None else bool(up)
         self.proj = Array()
         self.exports = ["weights", "proj", "bias"]
 
@@ -98,15 +107,21 @@ class MultiHeadAttention(ForwardBase):
     def output_shape_for(self, input_shape):
         return tuple(input_shape)
 
+    def _resolved_use_pallas(self):
+        from .nn_units import resolve_use_pallas
+        return resolve_use_pallas(self.use_pallas, self.device,
+                                  tpu_auto=True)
+
     def _attend(self, q, k, v):
         from ..parallel.ring import attention_reference, ring_attention
+        use_pallas = self._resolved_use_pallas()
         if self.mesh is not None and self.seq_axis in self.mesh.shape:
             return ring_attention(q, k, v, self.mesh,
                                   seq_axis=self.seq_axis,
                                   data_axis=self.data_axis,
                                   causal=self.causal,
-                                  use_pallas=self.use_pallas)
-        if self.use_pallas:
+                                  use_pallas=use_pallas)
+        if use_pallas:
             # the flash kernel pair: O(T*D) HBM traffic instead of the
             # oracle's materialized [T, T] scores (falls back to the
             # oracle internally when T can't be tiled)

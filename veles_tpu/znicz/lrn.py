@@ -174,8 +174,12 @@ class LRNormalizerForward(ParamlessForward):
         self.n = int(kwargs.get("n", 5))
         self.include_bias = False
         from ..config import root
-        self.use_pallas = bool(kwargs.get(
-            "use_pallas", root.common.engine.get("use_pallas", False)))
+        # tri-state like attention's knob (nn_units.resolve_use_pallas)
+        # — but AUTO resolves False here: the Pallas pair measured a
+        # LOSS vs the MXU-band XLA path (docs/PERF.md, ~0.68x)
+        up = kwargs.get("use_pallas",
+                        root.common.engine.get("use_pallas", None))
+        self.use_pallas = up if up is None else bool(up)
 
     def _den(self, sq, xp):
         acc = _window_sum(sq, self.n, xp)
@@ -184,7 +188,9 @@ class LRNormalizerForward(ParamlessForward):
     def apply(self, params, x):
         import jax.numpy as jnp
         from jax import lax
-        if self.use_pallas:
+        from .nn_units import resolve_use_pallas
+        if resolve_use_pallas(self.use_pallas, self.device,
+                              tpu_auto=False):
             return pallas_lrn(x, self.n, self.alpha, self.beta, self.k)
         # MXU path: one banded matmul instead of n shifted HBM passes
         # (autodiff gives the transposed band for the backward)

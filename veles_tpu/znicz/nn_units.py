@@ -27,6 +27,48 @@ from .. import prng
 from . import solvers
 
 
+_oracle_only_depth = 0
+
+
+class oracle_only:
+    """Context manager forcing every Pallas-capable unit onto its pure
+    XLA/jnp formulation while tracing (regardless of knobs).  Used by
+    the exporter: a Mosaic ``tpu_custom_call`` baked into a StableHLO
+    artifact would break the package's any-backend portability
+    contract (export/loader.py)."""
+
+    def __enter__(self):
+        global _oracle_only_depth
+        _oracle_only_depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _oracle_only_depth
+        _oracle_only_depth -= 1
+        return False
+
+
+def resolve_use_pallas(setting, device, tpu_auto):
+    """Shared tri-state ``use_pallas`` semantics for every
+    Pallas-capable unit: True/False force the choice; None (unset) =
+    AUTO — the per-unit measured best, which is ``tpu_auto`` when the
+    unit's device is the TPU and False elsewhere (CPU interpret-mode
+    kernels are orders slower; docs/PERF.md carries the per-kernel
+    measurements: flash attention wins on TPU, the LRN pair loses).
+    Inside :class:`oracle_only` everything resolves False."""
+    if _oracle_only_depth:
+        return False
+    if setting is not None:
+        return bool(setting)
+    if not tpu_auto:
+        return False
+    backend = getattr(device, "BACKEND", None)
+    if backend is None:  # unit not initialized (direct apply/trace)
+        import jax
+        return jax.default_backend() == "tpu"
+    return backend == "tpu"
+
+
 class NNUnitBase(AcceleratedUnit):
     hide_from_registry = True
 
