@@ -382,3 +382,76 @@ def test_forge_page_unconfigured_is_404(tmp_path):
         assert err.value.code == 404
     finally:
         server.stop()
+
+
+def test_bboxer_page_on_status_server(tmp_path):
+    """The status server's /bboxer page is the bbox annotation tool
+    (role of the reference's node bboxer app,
+    /root/reference/web/projects/bboxer/src/js): image list + canvas
+    UI, per-image boxes persisted to bboxes.json via POST."""
+    from veles_tpu.config import root
+    from veles_tpu.web_status import StatusServer
+
+    (tmp_path / "a.png").write_bytes(b"\x89PNG fake")
+    (tmp_path / "b.jpg").write_bytes(b"\xff\xd8 fake")
+    (tmp_path / "notes.txt").write_bytes(b"not an image")
+    prior = root.common.bboxer.get("image_dir", None)
+    root.common.bboxer.image_dir = str(tmp_path)
+    server = StatusServer(port=0)
+    base = "http://127.0.0.1:%d" % server.port
+    try:
+        page = urllib.request.urlopen(base + "/bboxer").read().decode()
+        assert "<canvas" in page and "/bboxer/save" in page
+        data = json.loads(urllib.request.urlopen(
+            base + "/bboxer/data").read())
+        assert data["images"] == ["a.png", "b.jpg"]  # txt excluded
+        assert data["boxes"] == {}
+        # save boxes for a.png, read them back
+        body = json.dumps({"image": "a.png",
+                           "boxes": [[1, 2, 30, 40, "cat"],
+                                     [5.5, 6, 7, 8, "dog"]]}).encode()
+        resp = urllib.request.urlopen(urllib.request.Request(
+            base + "/bboxer/save", data=body, method="POST"))
+        assert json.loads(resp.read())["ok"] is True
+        data = json.loads(urllib.request.urlopen(
+            base + "/bboxer/data").read())
+        assert data["boxes"]["a.png"][0] == [1, 2, 30, 40, "cat"]
+        on_disk = json.loads((tmp_path / "bboxes.json").read_text())
+        assert on_disk["a.png"][1][4] == "dog"
+        # image bytes served; traversal and non-images 404
+        img = urllib.request.urlopen(base + "/bboxer/img/a.png").read()
+        assert img == b"\x89PNG fake"
+        for bad in ("/bboxer/img/../bboxes.json",
+                    "/bboxer/img/notes.txt",
+                    "/bboxer/img/missing.png"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(base + bad)
+            assert err.value.code == 404
+        # malformed payloads are 400, not 500
+        for payload in (b"{", b'{"image": "a.png", "boxes": [[1]]}',
+                        b'{"boxes": []}'):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(urllib.request.Request(
+                    base + "/bboxer/save", data=payload,
+                    method="POST"))
+            assert err.value.code == 400
+    finally:
+        server.stop()
+        if prior is None:
+            del root.common.bboxer.image_dir
+        else:
+            root.common.bboxer.image_dir = prior
+
+
+def test_bboxer_unconfigured_is_404():
+    from veles_tpu.config import root
+    from veles_tpu.web_status import StatusServer
+    assert root.common.bboxer.get("image_dir", None) is None
+    server = StatusServer(port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/bboxer" % server.port)
+        assert err.value.code == 404
+    finally:
+        server.stop()

@@ -447,7 +447,12 @@ class Main:
         for assignment in args.sets:
             argv += ["--set", assignment]
         if args.random_seed is not None:
-            argv += ["--random-seed", str(args.random_seed)]
+            # forward the RESOLVED int, not the spec: a PATH:NBYTES
+            # spec (e.g. /dev/urandom:16) re-read per trial would give
+            # every trial a different seed, breaking the determinism
+            # guarantee trials rely on
+            argv += ["--random-seed",
+                     str(parse_seed(args.random_seed))]
         # class-contributed flags travel as config overrides so trials
         # see them too (the flags themselves are parsed per process)
         for dest, path in self._arg_paths.items():

@@ -104,6 +104,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._serve_logs()
         elif route == "/forge" or route.startswith("/forge/"):
             self._serve_forge(route)
+        elif route == "/bboxer" or route.startswith("/bboxer/"):
+            self._serve_bboxer(route)
         elif route == "/":
             self._send(200, self._dashboard(), "text/html")
         else:
@@ -164,6 +166,65 @@ class _Handler(BaseHTTPRequestHandler):
             "<th>duration</th><th>args</th></tr>%s</table>"
             "</body></html>" % (len(rows), esc(path), "".join(rows))),
             "text/html")
+
+    _IMG_EXT = {".png": "image/png", ".jpg": "image/jpeg",
+                ".jpeg": "image/jpeg", ".bmp": "image/bmp",
+                ".gif": "image/gif"}
+
+    @staticmethod
+    def _bboxer_dir():
+        from .config import root
+        return root.common.bboxer.get("image_dir", None)
+
+    @classmethod
+    def _bboxer_store(cls, image_dir):
+        return os.path.join(image_dir, "bboxes.json")
+
+    def _serve_bboxer(self, route):
+        """Bounding-box annotation tool (the role of the reference's
+        node bboxer app, /root/reference/web/projects/bboxer/src/js,
+        rebuilt server-rendered and dependency-free): ``/bboxer`` is a
+        canvas UI over the images in ``root.common.bboxer.image_dir``;
+        drag to draw, boxes persist per image to ``bboxes.json`` in
+        the same directory via POST /bboxer/save.  ``/bboxer/data``
+        returns {images, boxes}; ``/bboxer/img/<name>`` serves one
+        image (basenames only — no path traversal)."""
+        image_dir = self._bboxer_dir()
+        if not image_dir or not os.path.isdir(image_dir):
+            self._send(404, json.dumps(
+                {"error": "set root.common.bboxer.image_dir to an "
+                          "image directory to annotate"}))
+            return
+        if route == "/bboxer":
+            self._send(200, _BBOXER_HTML, "text/html")
+            return
+        if route == "/bboxer/data":
+            images = sorted(
+                f for f in os.listdir(image_dir)
+                if os.path.splitext(f)[1].lower() in self._IMG_EXT)
+            boxes = {}
+            store = self._bboxer_store(image_dir)
+            if os.path.isfile(store):
+                try:
+                    with open(store) as f:
+                        boxes = json.load(f)
+                except ValueError:
+                    boxes = {}
+            self._send(200, json.dumps(
+                {"images": images, "boxes": boxes}))
+            return
+        if route.startswith("/bboxer/img/"):
+            name = os.path.basename(
+                urllib.parse.unquote(route[len("/bboxer/img/"):]))
+            ext = os.path.splitext(name)[1].lower()
+            path = os.path.join(image_dir, name)
+            if ext not in self._IMG_EXT or not os.path.isfile(path):
+                self._send(404, '{"error": "no such image"}')
+                return
+            with open(path, "rb") as f:
+                self._send(200, f.read(), self._IMG_EXT[ext])
+            return
+        self._send(404, '{"error": "not found"}')
 
     def _serve_forge(self, route):
         """Forge model-marketplace browser (the role of the reference's
@@ -290,6 +351,7 @@ class _Handler(BaseHTTPRequestHandler):
             "<p><a href=\"/plots\">plots</a> · "
             "<a href=\"/logs\">logs</a> · "
             "<a href=\"/forge\">forge</a> · "
+            "<a href=\"/bboxer\">bboxer</a> · "
             "<a href=\"/status\">status JSON</a> · "
             "<a href=\"/history\">history JSON</a></p></body></html>"
             % ("".join(sections) or "<p>no workflows reporting</p>"))
@@ -329,6 +391,9 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, data, ctype)
 
     def do_POST(self):
+        if self.path == "/bboxer/save":
+            self._bboxer_save()
+            return
         if self.path != "/update":
             self._send(404, '{"error": "not found"}')
             return
@@ -343,6 +408,109 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError):
             self._send(400, '{"error": "bad json"}')
 
+    def _bboxer_save(self):
+        """POST {image, boxes: [[x, y, w, h, label], ...]} — replaces
+        that image's box list in bboxes.json (atomic rewrite)."""
+        image_dir = self._bboxer_dir()
+        if not image_dir or not os.path.isdir(image_dir):
+            self._send(404, '{"error": "bboxer not configured"}')
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            name = os.path.basename(str(payload["image"]))
+            boxes = payload["boxes"]
+            if not isinstance(boxes, list) or not all(
+                    isinstance(b, list) and len(b) == 5 and
+                    all(isinstance(c, (int, float)) for c in b[:4])
+                    for b in boxes):
+                raise ValueError("boxes must be [x, y, w, h, label]")
+        except (KeyError, ValueError, TypeError):
+            self._send(400, '{"error": "bad bbox payload"}')
+            return
+        store = self._bboxer_store(image_dir)
+        # the UI fires an async save per mouseup and the server is
+        # threaded: serialize the read-modify-write or a concurrent
+        # save of another image silently vanishes from disk
+        with _bboxer_lock:
+            data = {}
+            if os.path.isfile(store):
+                try:
+                    with open(store) as f:
+                        data = json.load(f)
+                except ValueError:
+                    data = {}
+            data[name] = boxes
+            tmp = store + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=1)
+            os.replace(tmp, store)
+        self._send(200, '{"ok": true}')
+
+
+#: the bboxer canvas UI (single self-contained page, no toolchain —
+#: the reference built this as a node/gulp app)
+#: serializes /bboxer/save read-modify-writes (threaded server)
+_bboxer_lock = threading.Lock()
+
+_BBOXER_HTML = """<!doctype html><html><head><title>bboxer</title>
+<style>body{font-family:sans-serif;margin:1em}#list{float:left;
+width:14em;overflow:auto;max-height:80vh}#list a{display:block;
+padding:.15em .4em;text-decoration:none;color:#036}#list a.cur
+{background:#def}#work{margin-left:15em}canvas{border:1px solid #999;
+cursor:crosshair;max-width:100%}#boxes td{border:1px solid #ccc;
+padding:.1em .4em;font-size:.85em}</style></head><body>
+<h2>bboxer</h2><div id=list></div><div id=work>
+<label>label: <input id=label value=object size=12></label>
+<button id=undo>undo box</button> <span id=msg></span><br>
+<canvas id=cv></canvas><table id=boxes></table></div><script>
+let images=[], boxesAll={}, cur=null, img=new Image(), drag=null;
+const cv=document.getElementById('cv'), ctx=cv.getContext('2d');
+function boxes(){ return boxesAll[cur] = boxesAll[cur] || []; }
+function draw(){ if(!img.complete) return;
+ cv.width=img.naturalWidth; cv.height=img.naturalHeight;
+ ctx.drawImage(img,0,0); ctx.lineWidth=2; ctx.font='13px sans-serif';
+ for(const b of boxes()){ ctx.strokeStyle='#e33';
+  ctx.strokeRect(b[0],b[1],b[2],b[3]); ctx.fillStyle='#e33';
+  ctx.fillText(b[4],b[0]+3,b[1]+13); }
+ if(drag){ ctx.strokeStyle='#39e';
+  ctx.strokeRect(drag[0],drag[1],drag[2]-drag[0],drag[3]-drag[1]); }
+ const t=document.getElementById('boxes');
+ t.textContent='';  /* rebuild via textContent: labels are user data */
+ for(const b of boxes()){ const tr=t.insertRow();
+  for(const x of b){ tr.insertCell().textContent =
+    typeof x=='number' ? Math.round(x) : x; } } }
+function pos(e){ const r=cv.getBoundingClientRect();
+ return [ (e.clientX-r.left)*cv.width/r.width,
+          (e.clientY-r.top)*cv.height/r.height ]; }
+cv.onmousedown=e=>{ const p=pos(e); drag=[p[0],p[1],p[0],p[1]]; };
+cv.onmousemove=e=>{ if(!drag) return; const p=pos(e);
+ drag[2]=p[0]; drag[3]=p[1]; draw(); };
+cv.onmouseup=e=>{ if(!drag) return;
+ const x=Math.min(drag[0],drag[2]), y=Math.min(drag[1],drag[3]),
+       w=Math.abs(drag[2]-drag[0]), h=Math.abs(drag[3]-drag[1]);
+ drag=null; if(w>3&&h>3){ boxes().push([x,y,w,h,
+  document.getElementById('label').value||'object']); save(); }
+ draw(); };
+document.getElementById('undo').onclick=()=>{ boxes().pop(); save();
+ draw(); };
+function save(){ fetch('/bboxer/save',{method:'POST',
+ body:JSON.stringify({image:cur,boxes:boxes()})}).then(r=>
+ document.getElementById('msg').textContent =
+   r.ok ? 'saved' : 'save failed'); }
+function show(name){ cur=name; img=new Image();
+ img.onload=draw; img.src='/bboxer/img/'+encodeURIComponent(name);
+ for(const a of document.querySelectorAll('#list a'))
+   a.className = a.textContent==name ? 'cur' : ''; }
+fetch('/bboxer/data').then(r=>r.json()).then(d=>{
+ images=d.images; boxesAll=d.boxes||{};
+ const l=document.getElementById('list');
+ for(const n of images){ const a=document.createElement('a');
+  a.href='#'; a.textContent=n;  /* filenames are untrusted: no HTML */
+  a.onclick=e=>{ e.preventDefault(); show(n); };
+  l.appendChild(a); }
+ if(images.length) show(images[0]); });
+</script></body></html>"""
 
 #: process-default registry: reporters publish here, servers serve it
 default_registry = StatusRegistry()

@@ -27,7 +27,9 @@ from .. import prng
 from . import solvers
 
 
-_oracle_only_depth = 0
+import threading as _threading
+
+_oracle_only_state = _threading.local()
 
 
 class oracle_only:
@@ -35,16 +37,17 @@ class oracle_only:
     XLA/jnp formulation while tracing (regardless of knobs).  Used by
     the exporter: a Mosaic ``tpu_custom_call`` baked into a StableHLO
     artifact would break the package's any-backend portability
-    contract (export/loader.py)."""
+    contract (export/loader.py).  Thread-LOCAL: an export on one
+    thread must not flip concurrent traces (e.g. a training retrace)
+    on other threads onto the slower oracle path."""
 
     def __enter__(self):
-        global _oracle_only_depth
-        _oracle_only_depth += 1
+        _oracle_only_state.depth = getattr(
+            _oracle_only_state, "depth", 0) + 1
         return self
 
     def __exit__(self, *exc):
-        global _oracle_only_depth
-        _oracle_only_depth -= 1
+        _oracle_only_state.depth -= 1
         return False
 
 
@@ -56,7 +59,7 @@ def resolve_use_pallas(setting, device, tpu_auto):
     kernels are orders slower; docs/PERF.md carries the per-kernel
     measurements: flash attention wins on TPU, the LRN pair loses).
     Inside :class:`oracle_only` everything resolves False."""
-    if _oracle_only_depth:
+    if getattr(_oracle_only_state, "depth", 0):
         return False
     if setting is not None:
         return bool(setting)
