@@ -1,0 +1,78 @@
+"""Hardware sweep: every znicz sample family builds, compiles, and
+trains a few epochs ON THE REAL TPU (the suite runs them CPU-hermetic;
+this catches chip-only breakage).  Pass/fail per sample + wall time."""
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+from veles_tpu.backends import Device  # noqa: E402
+from veles_tpu.prng import RandomGenerator  # noqa: E402
+from veles_tpu import prng  # noqa: E402
+
+assert jax.default_backend() == "tpu", jax.default_backend()
+
+SAMPLES = [
+    ("mnist", dict(loader={"minibatch_size": 60, "n_train": 600,
+                           "n_valid": 120,
+                           "prng": RandomGenerator().seed(3)},
+                   decision={"max_epochs": 3, "silent": True})),
+    ("mnist_ae", dict(loader={"minibatch_size": 100, "n_train": 500,
+                              "n_valid": 100,
+                              "prng": RandomGenerator().seed(3)},
+                      decision={"max_epochs": 3, "silent": True})),
+    ("kohonen", dict(decision={"max_epochs": 4, "silent": True})),
+    ("lines", dict(loader={"minibatch_size": 40, "n_train": 200,
+                           "n_valid": 60,
+                           "prng": RandomGenerator().seed(3)},
+                   decision={"max_epochs": 3, "silent": True})),
+    ("kanji", dict(loader={"minibatch_size": 50, "n_train": 200,
+                           "n_valid": 50,
+                           "prng": RandomGenerator().seed(3)},
+                   decision={"max_epochs": 3, "silent": True})),
+    ("video_ae", dict(loader={"minibatch_size": 50, "n_train": 100,
+                              "n_valid": 50,
+                              "prng": RandomGenerator().seed(3)},
+                      decision={"max_epochs": 3, "silent": True})),
+    ("cifar", dict(loader={"minibatch_size": 50, "n_train": 300,
+                           "n_valid": 100,
+                           "prng": RandomGenerator().seed(3)},
+                   decision={"max_epochs": 2, "silent": True})),
+    ("stl10", dict(loader={"minibatch_size": 50, "n_train": 200,
+                           "n_valid": 50,
+                           "prng": RandomGenerator().seed(3)},
+                   decision={"max_epochs": 2, "silent": True})),
+    ("alexnet", dict(loader={"minibatch_size": 64, "n_train": 128,
+                             "n_valid": 64,
+                             "prng": RandomGenerator().seed(3)},
+                     decision={"max_epochs": 2, "silent": True})),
+]
+
+failures = []
+for name, cfg in SAMPLES:
+    prng.get().seed(42)
+    t0 = time.perf_counter()
+    try:
+        mod = __import__("veles_tpu.znicz.samples." + name,
+                         fromlist=[name])
+        wf = mod.create_workflow(**cfg)
+        wf.initialize(device=Device(backend="auto"))
+        wf.run()
+        res = wf.gather_results()
+        key = sorted(res)[0] if res else None
+        print("PASS %-10s %6.1fs  %s" % (
+            name, time.perf_counter() - t0,
+            {k: res[k] for k in list(res)[:2]}), flush=True)
+    except Exception:
+        failures.append(name)
+        print("FAIL %-10s %6.1fs" % (name, time.perf_counter() - t0),
+              flush=True)
+        traceback.print_exc()
+
+print("sweep:", "ALL PASS" if not failures else
+      "FAILURES: %s" % failures, flush=True)
+sys.exit(1 if failures else 0)
