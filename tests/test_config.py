@@ -68,3 +68,32 @@ def test_todict_roundtrip():
     c = Config("test")
     c.update({"a": 1, "s": {"b": 2}})
     assert c.todict() == {"a": 1, "s": {"b": 2}}
+
+
+def test_build_standard_accepts_plain_dict_config_nodes(tmp_path):
+    """Config files may ASSIGN plain dicts (root.x.snapshotter =
+    {...}) instead of update()-ing; sample builders must accept both
+    forms.  Regression: --ensemble-train with an assigned snapshotter
+    dict crashed with \"'dict' object has no attribute 'todict'\".
+    Plain dicts stay plain (non-string keys, == comparisons)."""
+    from veles_tpu.config import Config, root
+    from veles_tpu.znicz.samples import mnist
+
+    prior = root.mnist.todict()
+    try:
+        root.mnist.snapshotter = {"directory": str(tmp_path),
+                                  "time_interval": 0}
+        root.mnist.decision = {"max_epochs": 1, "silent": True}
+        wf = mnist.create_workflow(
+            loader={"minibatch_size": 60, "n_train": 120,
+                    "n_valid": 60})
+        assert wf.snapshotter is not None
+        # assignment did NOT coerce the stored value
+        assert isinstance(root.mnist.__dict__["snapshotter"], dict)
+        c = Config("t")
+        c.label_map = {0: "cat"}          # non-string keys fine
+        assert c.label_map == {0: "cat"}  # == still works
+    finally:
+        del root.mnist.snapshotter
+        del root.mnist.decision
+        root.mnist.update(prior)

@@ -133,3 +133,127 @@ def test_resolve_use_pallas_semantics():
     with oracle_only():
         assert resolve_use_pallas(True, FakeTPU(), tpu_auto=True) is False
     assert resolve_use_pallas(True, FakeTPU(), tpu_auto=True) is True
+
+
+@pytest.mark.parametrize("window", [1, 5, 64, 100, 256])
+def test_window_forward_matches_bruteforce(window):
+    """Sliding-window masking vs an explicit brute-force mask, at
+    window sizes below / straddling / above the block size (the
+    off-by-one-prone boundaries live at block edges)."""
+    q, k, v = _mk(1, 256, 2, 16, seed=4)
+    got = flash_attention(q, k, v, True, None, 64, 64, window)
+    oracle = attention_reference(q, k, v, causal=True, window=window)
+    # independent brute force: softmax over the explicit band
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(16.0)
+    rows = jnp.arange(256)[:, None]
+    cols = jnp.arange(256)[None, :]
+    banned = (cols > rows) | (cols <= rows - window)
+    p = jax.nn.softmax(jnp.where(banned, -jnp.inf, s), axis=-1)
+    brute = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    numpy.testing.assert_allclose(numpy.asarray(oracle),
+                                  numpy.asarray(brute),
+                                  rtol=1e-5, atol=1e-5)
+    numpy.testing.assert_allclose(numpy.asarray(got),
+                                  numpy.asarray(brute),
+                                  rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("window", [5, 64, 100])
+def test_window_gradients_match_oracle(window):
+    q, k, v = _mk(1, 128, 2, 8, seed=5)
+
+    def loss(attend):
+        def f(q, k, v):
+            return jnp.sum(jnp.sin(attend(q, k, v)) ** 2)
+        return f
+
+    got = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, True, None, 64, 32, window)), argnums=(0, 1, 2))(
+        q, k, v)
+    want = jax.grad(loss(lambda q, k, v: attention_reference(
+        q, k, v, causal=True, window=window)), argnums=(0, 1, 2))(
+        q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        numpy.testing.assert_allclose(
+            numpy.asarray(g), numpy.asarray(w), rtol=5e-4, atol=5e-4,
+            err_msg="d%s diverges (window=%d)" % (name, window))
+
+
+def test_window_requires_causal():
+    q, k, v = _mk(1, 64, 1, 8)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, False, None, 32, 32, 8)
+    with pytest.raises(ValueError, match="causal"):
+        attention_reference(q, k, v, window=8)
+
+
+def test_window_unit_path():
+    """MultiHeadAttention(window=...) through both engines; ring mesh
+    with a window is a loud NotImplementedError."""
+    from veles_tpu.backends import Device
+    from veles_tpu.memory import Array
+    from veles_tpu.parallel.mesh import make_mesh
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.znicz.attention import MultiHeadAttention
+
+    rng = numpy.random.RandomState(6)
+    x = rng.standard_normal((2, 64, 16)).astype(numpy.float32)
+    outs = {}
+    for use_pallas in (False, True):
+        wf = Workflow(name="mha-window-%s" % use_pallas)
+        unit = MultiHeadAttention(wf, heads=2, causal=True, window=10,
+                                  use_pallas=use_pallas,
+                                  prng=RandomGenerator().seed(7))
+        unit.input = Array(x.copy())
+        unit.initialize(device=Device(backend="cpu"))
+        unit.run()
+        assert unit.export_params()["window"] == 10
+        outs[use_pallas] = numpy.asarray(unit.output.map_read())
+    numpy.testing.assert_allclose(outs[True], outs[False],
+                                  rtol=2e-5, atol=2e-5)
+    wf = Workflow(name="mha-window-mesh")
+    with pytest.raises(ValueError, match="causal"):
+        MultiHeadAttention(wf, heads=2, window=4)
+    unit = MultiHeadAttention(wf, heads=2, causal=True, window=4,
+                              mesh=make_mesh({"seq": 8}),
+                              prng=RandomGenerator().seed(7))
+    unit.input = Array(x.copy())
+    with pytest.raises(NotImplementedError, match="window"):
+        unit.initialize(device=Device(backend="cpu"))
+        unit.run()
+
+
+def test_window_banded_backward_geometry():
+    """Gradients at a geometry where BOTH backward passes take the
+    banded grid (band < n_blocks on each streamed axis): T=256,
+    32x32 blocks, window=40 -> k-band 4 of 8, q-band 4 of 8."""
+    from veles_tpu.znicz.flash_attention import (_kband_size,
+                                                 _qband_size)
+    assert _kband_size(32, 32, 40) < 256 // 32
+    assert _qband_size(32, 32, 40) < 256 // 32
+    q, k, v = _mk(1, 256, 2, 8, seed=8)
+
+    got = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(flash_attention(
+        q, k, v, True, None, 32, 32, 40))), argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(
+        attention_reference(q, k, v, causal=True, window=40))),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        numpy.testing.assert_allclose(
+            numpy.asarray(g), numpy.asarray(w), rtol=5e-4, atol=5e-4,
+            err_msg="d%s diverges" % name)
+
+
+def test_window_rejects_nonpositive():
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.znicz.attention import MultiHeadAttention
+    q, k, v = _mk(1, 64, 1, 8)
+    for w in (0, -3):
+        with pytest.raises(ValueError, match=">= 1"):
+            flash_attention(q, k, v, True, None, 32, 32, w)
+        with pytest.raises(ValueError, match=">= 1"):
+            attention_reference(q, k, v, causal=True, window=w)
+        with pytest.raises(ValueError, match=">= 1"):
+            MultiHeadAttention(Workflow(name="w"), heads=1,
+                               causal=True, window=w)

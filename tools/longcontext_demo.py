@@ -20,6 +20,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 import numpy  # noqa: E402
+import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 from tools.ab_flash_attention import train_shaped  # noqa: E402
@@ -34,13 +35,20 @@ def run(t, reps=5):
                            jnp.float32) for _ in range(3))
     # full grads as jit outputs (train_shaped) — the x3 TFLOP
     # accounting below assumes the whole backward ran
-    step = train_shaped(
+    inner = train_shaped(
         lambda q, k, v: flash_attention(q, k, v, True), chain=1)
-    numpy.asarray(step(q, k, v)[0])[0, 0]  # compile + flush
+    # device-side reduce over ALL THREE outputs for the flush:
+    # numpy.asarray(q') would drag the whole O(T*D) tensor through the
+    # ~13 MB/s tunnel (once overstated T=32k ~7x), and reducing only
+    # q' would let XLA dead-code-eliminate the dk/dv kernel (review
+    # catch — the x3 TFLOP accounting requires the full backward)
+    step = jax.jit(lambda q, k, v: sum(
+        jnp.sum(x) for x in inner(q, k, v)))
+    float(step(q, k, v))  # compile + flush
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        numpy.asarray(step(q, k, v)[0])[0, 0]
+        float(step(q, k, v))
         times.append(time.perf_counter() - t0)
     best = min(times)
     # causal ~halves the score FLOPs; x3 for fwd+bwd

@@ -63,10 +63,9 @@ for name, cfg in SAMPLES:
         wf.initialize(device=Device(backend="auto"))
         wf.run()
         res = wf.gather_results()
-        key = sorted(res)[0] if res else None
+        shown = {k: res[k] for k in sorted(res)[:2]}
         print("PASS %-10s %6.1fs  %s" % (
-            name, time.perf_counter() - t0,
-            {k: res[k] for k in list(res)[:2]}), flush=True)
+            name, time.perf_counter() - t0, shown), flush=True)
     except Exception:
         failures.append(name)
         print("FAIL %-10s %6.1fs" % (name, time.perf_counter() - t0),

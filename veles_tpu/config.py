@@ -64,6 +64,10 @@ class Config:
     def __setattr__(self, name, value):
         if name in Config._protected:
             raise AttributeError("'%s' is a protected Config key" % name)
+        # NOTE: plain-dict assignment stays a plain dict on purpose
+        # (data dicts may have non-string keys, and users compare the
+        # value back with ==); tree consumers must accept either form
+        # — see znicz/samples/__init__.py _cfg_dict
         self.__dict__[name] = value
 
     def __delattr__(self, name):

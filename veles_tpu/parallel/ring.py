@@ -24,14 +24,25 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def attention_reference(q, k, v, causal=False, scale=None):
+def attention_reference(q, k, v, causal=False, scale=None,
+                        window=None):
     """Plain single-device softmax attention, [B, T, H, D] layout —
-    the parity oracle (and the small-model fallback)."""
+    the parity oracle (and the small-model fallback).  ``window``
+    (requires ``causal``): sliding-window attention — position i sees
+    keys in (i - window, i], the Mistral-style band."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
+    if window is not None and window < 1:
+        raise ValueError("window must be >= 1, got %r" % (window,))
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         tq, tk = s.shape[-2], s.shape[-1]
-        mask = jnp.arange(tk)[None, :] > jnp.arange(tq)[:, None]
+        rows = jnp.arange(tq)[:, None]
+        cols = jnp.arange(tk)[None, :]
+        mask = cols > rows
+        if window is not None:
+            mask = mask | (cols <= rows - window)
         s = jnp.where(mask, -jnp.inf, s)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)

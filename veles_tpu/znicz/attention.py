@@ -29,6 +29,9 @@ class MultiHeadAttention(ForwardBase):
     kwargs:
       heads: number of attention heads (must divide D);
       causal: autoregressive masking;
+      window: sliding-window (Mistral-style) attention — position i
+        sees keys in (i - window, i]; requires ``causal``; on the
+        flash path, off-band blocks skip their MXU work;
       mesh/seq_axis/data_axis: when a ``jax.sharding.Mesh`` with a seq
         axis is given, attention runs as RING attention over it
         (sequence parallelism; parallel/ring.py) — the single-device
@@ -51,6 +54,14 @@ class MultiHeadAttention(ForwardBase):
         super().__init__(workflow, **kwargs)
         self.heads = int(kwargs.get("heads", 1))
         self.causal = bool(kwargs.get("causal", False))
+        self.window = kwargs.get("window")
+        if self.window is not None:
+            self.window = int(self.window)
+            if not self.causal:
+                raise ValueError("window requires causal=True")
+            if self.window < 1:
+                raise ValueError("window must be >= 1, got %d"
+                                 % self.window)
         self.mesh = kwargs.get("mesh")
         self.seq_axis = kwargs.get("seq_axis", "seq")
         self.data_axis = kwargs.get("data_axis")
@@ -116,6 +127,11 @@ class MultiHeadAttention(ForwardBase):
         from ..parallel.ring import attention_reference, ring_attention
         use_pallas = self._resolved_use_pallas()
         if self.mesh is not None and self.seq_axis in self.mesh.shape:
+            if self.window is not None:
+                raise NotImplementedError(
+                    "sliding-window attention over a seq mesh axis is "
+                    "not implemented (a window <= T_local would never "
+                    "need the ring anyway — shard other axes instead)")
             return ring_attention(q, k, v, self.mesh,
                                   seq_axis=self.seq_axis,
                                   data_axis=self.data_axis,
@@ -126,8 +142,10 @@ class MultiHeadAttention(ForwardBase):
             # oracle's materialized [T, T] scores (falls back to the
             # oracle internally when T can't be tiled)
             from .flash_attention import flash_attention
-            return flash_attention(q, k, v, self.causal)
-        return attention_reference(q, k, v, causal=self.causal)
+            return flash_attention(q, k, v, self.causal,
+                                   window=self.window)
+        return attention_reference(q, k, v, causal=self.causal,
+                                   window=self.window)
 
     def apply(self, params, x):
         b, t, d = x.shape
@@ -142,8 +160,11 @@ class MultiHeadAttention(ForwardBase):
         return y
 
     def export_params(self):
-        return {"heads": int(self.heads), "causal": bool(self.causal),
-                "include_bias": bool(self.include_bias)}
+        out = {"heads": int(self.heads), "causal": bool(self.causal),
+               "include_bias": bool(self.include_bias)}
+        if self.window is not None:
+            out["window"] = int(self.window)
+        return out
 
 
 class GDMultiHeadAttention(GradientDescentBase):

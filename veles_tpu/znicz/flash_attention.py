@@ -77,35 +77,80 @@ def flash_attention_supported(t, block_q=DEFAULT_BLOCK_Q,
     return _blocks(t, block_q, block_k) is not None
 
 
-def _block_needed(iq, jk, block_q, block_k):
+def _block_needed(iq, jk, block_q, block_k, window=None):
     """Causal: does Q block iq see any of K block jk?  (first key pos
-    <= last query pos)"""
-    return jk * block_k <= iq * block_q + block_q - 1
+    <= last query pos; with a sliding ``window``, also last key pos
+    inside the band of the first query pos)"""
+    vis = jk * block_k <= iq * block_q + block_q - 1
+    if window is not None:
+        # query i sees keys in (i - window, i]: block visible iff its
+        # LAST key > FIRST query - window
+        vis = jnp.logical_and(
+            vis, jk * block_k + block_k - 1 > iq * block_q - window)
+    return vis
 
 
-def _mask_causal(s, iq, jk, block_q, block_k):
+def _mask_causal(s, iq, jk, block_q, block_k, window=None):
     rows = iq * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     cols = jk * block_k + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(cols > rows, _NEG_INF, s)
+    mask = cols > rows
+    if window is not None:
+        mask = jnp.logical_or(mask, cols <= rows - window)
+    return jnp.where(mask, _NEG_INF, s)
+
+
+# -- sliding-window band geometry --------------------------------------------
+#
+# With a window the kernels run a BANDED grid: the streamed axis only
+# visits the blocks a pinned block can actually see, so compute AND
+# block DMA are O(T * window) instead of O(T^2).  The streamed grid
+# index j maps to a logical block via the band start; index_maps clip
+# into range and the in-kernel predicate skips any overshoot.
+
+
+def _kband_start(iq, block_q, block_k, window):
+    """First K block visible to Q block iq (keys > iq*bq - window)."""
+    return jnp.maximum(0, (iq * block_q - window + 1) // block_k)
+
+
+def _kband_size(block_q, block_k, window):
+    """K blocks any single Q block can see, worst case over phases."""
+    return (block_q + window - 2) // block_k + 2
+
+
+def _qband_start(jk, block_q, block_k):
+    """First Q block that sees K block jk (causal: queries >= keys)."""
+    return (jk * block_k) // block_q
+
+
+def _qband_size(block_q, block_k, window):
+    """Q blocks any single K block is visible to, worst case."""
+    return (block_k + window - 2) // block_q + 2
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                acc_scr, *, scale, causal, block_q, block_k):
+                acc_scr, *, scale, causal, block_q, block_k,
+                window=None, window_grid=None):
     from jax.experimental import pallas as pl
 
-    iq, jk = pl.program_id(1), pl.program_id(2)
-    n_k = pl.num_programs(2)
+    iq, j = pl.program_id(1), pl.program_id(2)
+    n_inner = pl.num_programs(2)
+    # banded grid (window_grid set): j is an offset into the band;
+    # window alone may also be set with a DENSE grid (band >= n_k),
+    # where the mask enforces it
+    jk = j if window_grid is None else _kband_start(
+        iq, block_q, block_k, window_grid) + j
 
-    @pl.when(jk == 0)
+    @pl.when(j == 0)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(_block_needed(iq, jk, block_q, block_k) if causal
-             else jk >= 0)
+    @pl.when(_block_needed(iq, jk, block_q, block_k, window)
+             if causal else jk >= 0)
     def _step():
         q = q_ref[0].astype(jnp.float32) * scale       # [BQ, D]
         kb = k_ref[0].astype(jnp.float32)              # [BK, D]
@@ -114,7 +159,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [BQ, BK]
         if causal:
-            s = _mask_causal(s, iq, jk, block_q, block_k)
+            s = _mask_causal(s, iq, jk, block_q, block_k, window)
         m = m_scr[...]
         new_m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # a fully-masked row keeps m at -inf: exp(-inf - -inf) must be
@@ -129,7 +174,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             preferred_element_type=jnp.float32)
         m_scr[...] = new_m
 
-    @pl.when(jk == n_k - 1)
+    @pl.when(j == n_inner - 1)
     def _finish():
         m, l = m_scr[...], l_scr[...]
         safe_l = jnp.where(l == 0.0, 1.0, l)
@@ -147,22 +192,37 @@ def _struct(shape, dtype, vma):
     return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
 
 
-def _flash_fwd_bh(q, k, v, scale, causal, block_q, block_k, vma=None):
+def _flash_fwd_bh(q, k, v, scale, causal, block_q, block_k, vma=None,
+                  window=None):
     """Forward over [BH, T, D] operands; returns (out, lse[BH, T])."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t, d = q.shape
     n_q, n_k = t // block_q, t // block_k
+    if window is not None and _kband_size(block_q, block_k,
+                                          window) >= n_k:
+        window_grid = None  # band covers everything: dense grid,
+        n_inner = n_k       # window enforced by the mask alone
+    else:
+        window_grid = window
+        n_inner = n_k if window is None else _kband_size(
+            block_q, block_k, window)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k)
+        block_k=block_k, window=window, window_grid=window_grid)
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    if window_grid is None:
+        k_index = lambda b, i, j: (b, j, 0)  # noqa: E731
+    else:
+        k_index = lambda b, i, j: (  # noqa: E731
+            b, jnp.clip(_kband_start(i, block_q, block_k, window_grid)
+                        + j, 0, n_k - 1), 0)
+    kspec = pl.BlockSpec((1, block_k, d), k_index)
     qrow = pl.BlockSpec((1, block_q, _STAT_LANES),
                         lambda b, i, j: (b, i, 0))
     out, lse = pl.pallas_call(
-        kernel, grid=(bh, n_q, n_k),
+        kernel, grid=(bh, n_q, n_inner),
         in_specs=[qspec, kspec, kspec],
         out_specs=[qspec, qrow],
         out_shape=[_struct((bh, t, d), q.dtype, vma),
@@ -175,18 +235,21 @@ def _flash_fwd_bh(q, k, v, scale, causal, block_q, block_k, vma=None):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, block_q, block_k):
+               dq_scr, *, scale, causal, block_q, block_k,
+               window=None, window_grid=None):
     from jax.experimental import pallas as pl
 
-    iq, jk = pl.program_id(1), pl.program_id(2)
-    n_k = pl.num_programs(2)
+    iq, j = pl.program_id(1), pl.program_id(2)
+    n_inner = pl.num_programs(2)
+    jk = j if window_grid is None else _kband_start(
+        iq, block_q, block_k, window_grid) + j
 
-    @pl.when(jk == 0)
+    @pl.when(j == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    @pl.when(_block_needed(iq, jk, block_q, block_k) if causal
-             else jk >= 0)
+    @pl.when(_block_needed(iq, jk, block_q, block_k, window)
+             if causal else jk >= 0)
     def _step():
         q = q_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
@@ -198,7 +261,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _mask_causal(s, iq, jk, block_q, block_k)
+            s = _mask_causal(s, iq, jk, block_q, block_k, window)
         p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - lse))
         dov = jax.lax.dot_general(
             do, vb, (((1,), (1,)), ((), ())),
@@ -208,26 +271,34 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds, kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    @pl.when(jk == n_k - 1)
+    @pl.when(j == n_inner - 1)
     def _finish():
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                block_q, block_k):
+                block_q, block_k, window=None, window_grid=None,
+                n_q_total=None):
     from jax.experimental import pallas as pl
 
-    jk, iq = pl.program_id(1), pl.program_id(2)
-    n_q = pl.num_programs(2)
+    jk, j = pl.program_id(1), pl.program_id(2)
+    n_inner = pl.num_programs(2)
+    iq = j if window_grid is None else _qband_start(
+        jk, block_q, block_k) + j
+    visible = (_block_needed(iq, jk, block_q, block_k, window)
+               if causal else iq >= 0)
+    if window_grid is not None:
+        # the q band's top is NOT capped by causality (unlike the
+        # fwd/dq k band): exclude overshoot past the last Q block
+        visible = jnp.logical_and(visible, iq <= n_q_total - 1)
 
-    @pl.when(iq == 0)
+    @pl.when(j == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_block_needed(iq, jk, block_q, block_k) if causal
-             else iq >= 0)
+    @pl.when(visible)
     def _step():
         kb = k_ref[0].astype(jnp.float32)
         vb = v_ref[0].astype(jnp.float32)
@@ -239,7 +310,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _mask_causal(s, iq, jk, block_q, block_k)
+            s = _mask_causal(s, iq, jk, block_q, block_k, window)
         p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - lse))
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -252,14 +323,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    @pl.when(iq == n_q - 1)
+    @pl.when(j == n_inner - 1)
     def _finish():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd_bh(q, k, v, out, lse, do, scale, causal, block_q,
-                  block_k, vma=None, delta=None):
+                  block_k, vma=None, delta=None, window=None):
     """lse (and the optional precomputed delta) may arrive either as
     [BH, T] rows or already lane-broadcast [BH, T, _STAT_LANES] — the
     ring backward hoists the broadcast out of its per-hop loop."""
@@ -278,28 +349,54 @@ def _flash_bwd_bh(q, k, v, out, lse, do, scale, causal, block_q,
                                  (bh, t, _STAT_LANES))
     if lse.ndim == 2:
         lse = jnp.broadcast_to(lse[..., None], (bh, t, _STAT_LANES))
+    # band geometry mirrors _flash_fwd_bh: banded grids only when they
+    # actually shrink the streamed axis
+    if window is not None and _kband_size(block_q, block_k,
+                                          window) < n_k:
+        wg_k, nk_inner = window, _kband_size(block_q, block_k, window)
+    else:
+        wg_k, nk_inner = None, n_k
+    if window is not None and _qband_size(block_q, block_k,
+                                          window) < n_q:
+        wg_q, nq_inner = window, _qband_size(block_q, block_k, window)
+    else:
+        wg_q, nq_inner = None, n_q
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     qrow = pl.BlockSpec((1, block_q, _STAT_LANES),
                         lambda b, i, j: (b, i, 0))
-    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    if wg_k is None:
+        dq_k_index = lambda b, i, j: (b, j, 0)  # noqa: E731
+    else:
+        dq_k_index = lambda b, i, j: (  # noqa: E731
+            b, jnp.clip(_kband_start(i, block_q, block_k, wg_k) + j,
+                        0, n_k - 1), 0)
+    kspec = pl.BlockSpec((1, block_k, d), dq_k_index)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
-        grid=(bh, n_q, n_k),
+                          block_q=block_q, block_k=block_k,
+                          window=window, window_grid=wg_k),
+        grid=(bh, n_q, nk_inner),
         in_specs=[qspec, kspec, kspec, qspec, qrow, qrow],
         out_specs=qspec,
         out_shape=_struct((bh, t, d), q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret())(q, k, v, do, lse, delta)
     # dk/dv pass: K block pinned per middle-grid step, Q streams inner
-    kq_spec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
-    kq_row = pl.BlockSpec((1, block_q, _STAT_LANES),
-                          lambda b, j, i: (b, i, 0))
+    if wg_q is None:
+        dkv_q_index = lambda b, j, i: (b, i, 0)  # noqa: E731
+    else:
+        dkv_q_index = lambda b, j, i: (  # noqa: E731
+            b, jnp.clip(_qband_start(j, block_q, block_k) + i,
+                        0, n_q - 1), 0)
+    kq_spec = pl.BlockSpec((1, block_q, d), dkv_q_index)
+    kq_row = pl.BlockSpec((1, block_q, _STAT_LANES), dkv_q_index)
     kk_spec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
-        grid=(bh, n_k, n_q),
+                          block_q=block_q, block_k=block_k,
+                          window=window, window_grid=wg_q,
+                          n_q_total=n_q),
+        grid=(bh, n_k, nq_inner),
         in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, kq_row, kq_row],
         out_specs=[kk_spec, kk_spec],
         out_shape=[_struct((bh, t, d), k.dtype, vma),
@@ -330,33 +427,42 @@ def _warn_fallback(t):
             t, _MIN_BLOCK, _MIN_BLOCK)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    window=None):
     """Flash attention, [B, T, H, D] — drop-in for
     ``attention_reference`` (falls back to it, with a logged warning,
-    when T can't be tiled)."""
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    when T can't be tiled).  ``window`` (requires ``causal``):
+    sliding-window attention — position i sees keys in
+    (i - window, i]; off-band blocks skip their MXU work entirely."""
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                        window)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None):
     from ..parallel.ring import attention_reference
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
+    if window is not None and window < 1:
+        raise ValueError("window must be >= 1, got %r" % (window,))
     b, t, h, d = q.shape
     blocks = _blocks(t, block_q, block_k)
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     if blocks is None:
         _warn_fallback(t)
-        out = attention_reference(q, k, v, causal=causal, scale=scale)
+        out = attention_reference(q, k, v, causal=causal, scale=scale,
+                                  window=window)
         return out, (q, k, v, out, None)
     bq, bk = blocks
     out_bh, lse = _flash_fwd_bh(_to_bh(q), _to_bh(k), _to_bh(v),
-                                scale, causal, bq, bk)
+                                scale, causal, bq, bk, window=window)
     out = _from_bh(out_bh, b, h)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, window, res, g):
     from ..parallel.ring import attention_reference
     q, k, v, out, lse = res
     b, t, h, d = q.shape
@@ -364,12 +470,13 @@ def _flash_bwd(causal, scale, block_q, block_k, res, g):
     if lse is None:  # untileable shape took the oracle path forward
         _, vjp = jax.vjp(
             lambda q, k, v: attention_reference(q, k, v, causal=causal,
-                                                scale=scale), q, k, v)
+                                                scale=scale,
+                                                window=window), q, k, v)
         return vjp(g)
     bq, bk = _blocks(t, block_q, block_k)
     dq, dk, dv = _flash_bwd_bh(
         _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(out), lse, _to_bh(g),
-        scale, causal, bq, bk)
+        scale, causal, bq, bk, window=window)
     return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))
 
 

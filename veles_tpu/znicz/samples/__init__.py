@@ -10,9 +10,15 @@ def build_standard(cfg, name, default_loader_factory, loss_function,
     ``mcdnnic_parameters``), whichever the config/overrides provide."""
     from ..standard_workflow import StandardWorkflow
     from ...config import Config
-    decision = cfg.decision.todict()
+
+    def _cfg_dict(v):
+        # config files may ASSIGN a plain dict (root.x.decision =
+        # {...}) instead of update()-ing into the tree — accept both
+        return v.todict() if isinstance(v, Config) else dict(v)
+
+    decision = _cfg_dict(cfg.decision)
     decision.update(overrides.pop("decision", {}))
-    loader = cfg.loader.todict()
+    loader = _cfg_dict(cfg.loader)
     loader.update(overrides.pop("loader", {}))
     topology = {}
     mcdnnic = overrides.pop("mcdnnic_topology",
@@ -23,14 +29,14 @@ def build_standard(cfg, name, default_loader_factory, loss_function,
     elif mcdnnic:
         params = overrides.pop("mcdnnic_parameters",
                                cfg.get("mcdnnic_parameters"))
-        if isinstance(params, Config):
-            params = params.todict()
+        if params is not None:
+            params = _cfg_dict(params)
         topology = {"mcdnnic_topology": mcdnnic,
                     "mcdnnic_parameters": params}
     else:
         topology["layers"] = cfg.layers
     if "snapshotter" in cfg and "snapshotter" not in overrides:
-        overrides["snapshotter"] = cfg.snapshotter.todict()
+        overrides["snapshotter"] = _cfg_dict(cfg.snapshotter)
     return StandardWorkflow(
         None, name=name,
         loader_factory=overrides.pop("loader_factory",
