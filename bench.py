@@ -33,6 +33,9 @@ Also reported in the same JSON line:
 - ``flash_attention_speedup`` — train-shaped (fwd+bwd) Pallas flash
   attention vs the XLA oracle at B2 T2048 H8 D64, interleaved — the
   hand-kernel-beats-XLA delta, recorded on the real chip each round.
+- ``window_attention_speedup`` — sliding-window (banded-grid) flash
+  vs full-causal flash, train-shaped at B1 T16384 W512 — the O(T*W)
+  band's recorded delta (grows linearly in T/W; docs/PERF.md).
 - ``flagship_tokens_per_sec`` — the modern-model path: one-chip
   train-step throughput of the flagship MoE transformer (all stages,
   all experts, single-device ``flagship_reference`` formulation; the
@@ -435,9 +438,9 @@ def bench_flash_attention(b=2, t=2048, h=8, d=64, reps=8, chain=4):
     XLA oracle that materializes [B, H, T, T]
     (znicz/flash_attention.py vs parallel/ring.py:27) — records the
     hand-kernel-beats-XLA delta on the real chip each round (round-5
-    measurement: train 1.03-1.14x at T=1k-4k, largest at longest T;
-    fwd-only and other windows in docs/PERF.md).  ``chain`` dependent
-    steps per dispatch amortize the tunnel RTT."""
+    clean-sync measurement: train 1.1-1.6x at T=1k-4k, moving with
+    contention windows; fwd >= parity; docs/PERF.md).  ``chain``
+    dependent steps per dispatch amortize the tunnel RTT."""
     import numpy
     import jax.numpy as jnp
     from tools.ab_flash_attention import time_pair, train_shaped
@@ -458,6 +461,35 @@ def bench_flash_attention(b=2, t=2048, h=8, d=64, reps=8, chain=4):
     return {"flash_attention_train_s": round(min(ta), 5),
             "attention_oracle_train_s": round(min(to), 5),
             "flash_attention_shape": [b, t, h, d]}
+
+
+def bench_window_attention(b=1, t=16384, h=8, d=64, w=512, reps=6,
+                           chain=2):
+    """Sliding-window (banded-grid) flash vs full-causal flash,
+    train-shaped and interleaved: records the O(T*W) band's delta on
+    the real chip.  T must be long enough that the step is
+    compute-bound, not dispatch-bound: at T=4096 both variants ride
+    under the launch latency and the ratio collapses to ~1.04x
+    (measured) — T=16k records 2.04x clean-sync, and the advantage
+    grows linearly in T/W (3.2x at T=32k, docs/PERF.md)."""
+    import numpy
+    import jax.numpy as jnp
+    from tools.ab_flash_attention import time_pair, train_shaped
+    from veles_tpu.znicz.flash_attention import flash_attention
+    _stamp("window-attention stage")
+    rng = numpy.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)) * 0.5,
+                           jnp.float32) for _ in range(3))
+    fw = train_shaped(lambda q, k, v: flash_attention(
+        q, k, v, True, window=w), chain)
+    ff = train_shaped(lambda q, k, v: flash_attention(
+        q, k, v, True), chain)
+    tw, tf = time_pair(fw, ff, (q, k, v), reps=reps, chain=chain)
+    _record("window_train", tw)
+    _record("full_causal_train", tf)
+    return {"window_attention_train_s": round(min(tw), 5),
+            "full_causal_train_s": round(min(tf), 5),
+            "window_attention_shape": [b, t, h, d, w]}
 
 
 def bench_flagship(stages=4, experts=4, d=256, heads=8, hidden=1024,
@@ -552,6 +584,8 @@ def _stage_main(stage):
         out = bench_flash_attention()
     elif stage == "flagship":
         out = bench_flagship()
+    elif stage == "window_attention":
+        out = bench_window_attention()
     elif stage == "pallas_lrn":
         ips = bench_alexnet_scan(batch=BATCH, use_pallas_lrn=True,
                                  repeats=3, name="alexnet_pallas_lrn")
@@ -586,9 +620,11 @@ STAGE_PLAN = [
     # dispatch amortization), so its compile+timed block needs more cap
     ("pallas_lrn", 420),
     ("precise_gemm", 300),
-    # trailing bonus metric: the modern-model (MoE transformer) path;
-    # skipped harmlessly when the budget is exhausted
+    # trailing bonus metrics: the modern-model (MoE transformer) path
+    # and the sliding-window band; skipped harmlessly when the budget
+    # is exhausted
     ("flagship", 420),
+    ("window_attention", 420),
 ]
 
 
@@ -667,6 +703,10 @@ def _orchestrate():
                line.get("attention_oracle_train_s"))
     if fl and orc:
         line["flash_attention_speedup"] = round(orc / fl, 3)
+    wt, fc = (line.get("window_attention_train_s"),
+              line.get("full_causal_train_s"))
+    if wt and fc:
+        line["window_attention_speedup"] = round(fc / wt, 3)
     if errors:
         line["stage_errors"] = errors
     line["spread"] = SPREAD

@@ -35,11 +35,15 @@ def train_shaped(attend, chain):
     """Jitted full train step xchain: grads wrt ALL THREE operands —
     grad wrt q alone would let XLA dead-code-eliminate an oracle's
     dK/dV matmuls while a flash custom-VJP kernel computes all three
-    (asymmetric A/B); all three updates are jit outputs so the LAST
-    iteration's dK/dV work can't be eliminated either.  Shared by
-    bench.py's flash_attention stage and tools/longcontext_demo.py —
-    the recorded metric and the tool that validated it must not
-    diverge."""
+    (asymmetric A/B).  Returns ONE SCALAR that consumes all three
+    updates: the last iteration's dK/dV work stays alive (no DCE)
+    while the caller's sync pulls 4 bytes — syncing on the updated
+    tensors themselves dragged the whole O(T*D) q'/k'/v' through the
+    ~30 MB/s tunnel every rep, which DILUTED every recorded ratio
+    toward 1 (at T=16k: ~1.1 s of D2H per dispatch vs ~0.1-0.2 s of
+    actual compute).  Shared by bench.py's flash/window stages and
+    tools/longcontext_demo.py — the recorded metric and the tool that
+    validated it must not diverge."""
     import jax
     import jax.numpy as jnp
 
@@ -50,7 +54,7 @@ def train_shaped(attend, chain):
         for _ in range(chain):
             gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
             q, k, v = q - 1e-3 * gq, k - 1e-3 * gk, v - 1e-3 * gv
-        return q, k, v
+        return jnp.sum(q) + jnp.sum(k) + jnp.sum(v)
     return jax.jit(run)
 
 
@@ -85,7 +89,9 @@ def ab_shape(b, t, h, d, causal=True, chain=4):
             out = q
             for _ in range(chain):  # data-dependent: one dispatch
                 out = attend(out, k, v)
-            return out
+            # scalar output: the sync must not drag O(T*D) through
+            # the tunnel (see train_shaped)
+            return jnp.sum(out)
         return jax.jit(run)
 
     flash = lambda q, k, v: flash_attention(q, k, v, causal)  # noqa: E731

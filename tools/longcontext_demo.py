@@ -20,7 +20,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 import numpy  # noqa: E402
-import jax  # noqa: E402
+
 import jax.numpy as jnp  # noqa: E402
 
 from tools.ab_flash_attention import train_shaped  # noqa: E402
@@ -33,17 +33,12 @@ def run(t, reps=5):
     rng = numpy.random.RandomState(0)
     q, k, v = (jnp.asarray(rng.standard_normal((1, t, H, D)) * 0.5,
                            jnp.float32) for _ in range(3))
-    # full grads as jit outputs (train_shaped) — the x3 TFLOP
-    # accounting below assumes the whole backward ran
-    inner = train_shaped(
+    # train_shaped returns a scalar consuming all three grads: the
+    # full backward runs (no DCE — the x3 TFLOP accounting needs it)
+    # and the flush pulls 4 bytes, not an O(T*D) tensor through the
+    # tunnel (both failure modes were review catches here)
+    step = train_shaped(
         lambda q, k, v: flash_attention(q, k, v, True), chain=1)
-    # device-side reduce over ALL THREE outputs for the flush:
-    # numpy.asarray(q') would drag the whole O(T*D) tensor through the
-    # ~13 MB/s tunnel (once overstated T=32k ~7x), and reducing only
-    # q' would let XLA dead-code-eliminate the dk/dv kernel (review
-    # catch — the x3 TFLOP accounting requires the full backward)
-    step = jax.jit(lambda q, k, v: sum(
-        jnp.sum(x) for x in inner(q, k, v)))
     float(step(q, k, v))  # compile + flush
     times = []
     for _ in range(reps):
