@@ -49,6 +49,13 @@ Also reported in the same JSON line:
   MNIST-FC epoch-scan anchor (1.127M img/s, the value the DRIVER
   recorded in BENCH_r01.json), kept as a regression canary for the
   dispatch/scan path.
+- ``serve_rps`` + ``serve_speedup_vs_per_request`` + ``serve_p99_ms`` +
+  ``serve_batch_fill`` — the inference-serving path
+  (tools/serve_bench.py): closed-loop req/s of the bucketed
+  dynamic-batching scheduler (veles_tpu.serving) vs the seed
+  per-request dispatch on the same exported MNIST package, with
+  ``serve_post_warmup_compiles`` recording the zero-recompile
+  guarantee.
 - ``spread`` — {name: [min_s, median_s, n]} per timed region, so
   contention claims are checkable from the JSON alone.
 
@@ -545,6 +552,27 @@ def bench_flagship(stages=4, experts=4, d=256, heads=8, hidden=1024,
                                 "hidden": hidden, "batch": b, "t": t}}
 
 
+def bench_serving(clients=8, seconds=2.0):
+    """Inference-serving throughput (tools/serve_bench.py): the bucketed
+    dynamic-batching scheduler vs the seed per-request path, closed-loop
+    with ``clients`` concurrent clients and mixed batch sizes on an
+    exported MNIST package.  Keys land in the record as ``serve_rps``,
+    ``serve_speedup_vs_per_request``, ``serve_p99_ms``,
+    ``serve_batch_fill`` — the serving-side counterpart of the training
+    MFU numbers."""
+    _stamp("serving stage")
+    from tools.serve_bench import run_bench
+    out = run_bench(clients=clients, seconds=seconds, transport="inproc")
+    return {"serve_rps": out.get("serve_rps"),
+            "serve_speedup_vs_per_request":
+                out.get("serve_speedup_vs_per_request"),
+            "serve_p50_ms": out.get("serve_p50_ms"),
+            "serve_p99_ms": out.get("serve_p99_ms"),
+            "serve_batch_fill": out.get("batch_fill"),
+            "serve_post_warmup_compiles":
+                out.get("post_warmup_compiles")}
+
+
 def bench_liveness():
     """Stage 0 gate: one tiny jitted matmul with a real D2H flush.  If
     THIS can't finish, the tunnel is down and the orchestrator reports
@@ -592,6 +620,8 @@ def _stage_main(stage):
         out = {"pallas_lrn_images_per_sec": round(ips, 1)}
     elif stage == "precise_gemm":
         out = {"precise_gemm": bench_precise_gemm()}
+    elif stage == "serving":
+        out = bench_serving()
     else:
         raise SystemExit("unknown stage %r" % stage)
     out["spread"] = SPREAD
@@ -625,6 +655,10 @@ STAGE_PLAN = [
     # is exhausted
     ("flagship", 420),
     ("window_attention", 420),
+    # the serving-path number (bucketed scheduler vs seed per-request
+    # dispatch) — cheap, but still optional-tail so a tight budget
+    # never trades a headline training stage for it
+    ("serving", 300),
 ]
 
 

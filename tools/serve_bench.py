@@ -1,0 +1,391 @@
+"""Serving load generator: req/s + tail latency for the inference path.
+
+Measures, on the SAME exported MNIST package and the SAME closed-loop
+load shape (N concurrent clients, mixed request batch sizes):
+
+- ``per_request_rps`` — the seed ``RESTfulAPI`` per-request path,
+  preserved here as the baseline: one ``PackageLoader.run`` (= one
+  ``jax.export`` call-wrapper rebuild + dispatch) per request, exactly
+  what restful_api.py did before the serving subsystem existed;
+- ``serve_rps`` — the bucketed dynamic-batching scheduler
+  (:class:`veles_tpu.serving.BucketScheduler`): warm AOT executables,
+  power-of-two padding, continuous batching.  The ratio is
+  ``serve_speedup_vs_per_request``;
+- ``serve_http_rps`` — the full :class:`InferenceServer` end to end
+  over HTTP/1.1 keep-alive (reported for context; on a small host this
+  measures the JSON+HTTP stack more than the scheduler);
+- open-loop mode (``--sustained``) — paced arrivals at
+  ``--offered-rps``, recording achieved rate, tail latency and shed
+  (429/overflow) counts, the way serving SLOs are actually stated.
+
+Emits ONE JSON line (bench.py convention):
+    {"metric": "serve_rps", "value": N, "unit": "req/s", ...}
+
+Smoke mode (``--smoke``) keeps everything under ~10 s so it can ride in
+the tier-1 suite; the sustained variant is the ``slow``-marked load
+test.  No training happens here — the model is an initialized (or
+``--package``-provided) MNIST FC net; throughput does not care about
+weight quality.
+"""
+
+import argparse
+import http.client
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+import numpy
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_SIZES = (1, 2, 3, 5, 8)
+
+
+def build_mnist_package(path):
+    """Initialize (not train) the MNIST FC sample and export it."""
+    from veles_tpu.backends import Device
+    from veles_tpu.export import export_model
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.znicz.samples import mnist
+    wf = mnist.create_workflow(
+        loader={"minibatch_size": 100, "n_train": 400, "n_valid": 100,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 1, "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    export_model(wf, path)
+    return path
+
+
+def _closed_loop(target, clients, seconds, sizes, sample_shape):
+    """N threads calling ``target(x)`` back to back; returns
+    (count, elapsed, latencies, errors)."""
+    xs = {bs: numpy.random.RandomState(bs).uniform(
+        -1, 1, (bs,) + tuple(sample_shape)).astype(numpy.float32)
+        for bs in sizes}
+    latencies = [[] for _ in range(clients)]
+    errors = [0] * clients
+    counts = [0] * clients
+    start = time.perf_counter()
+    stop = start + seconds
+    def client(i):
+        j = i
+        while time.perf_counter() < stop:
+            x = xs[sizes[j % len(sizes)]]
+            t0 = time.perf_counter()
+            try:
+                target(x)
+            except Exception:
+                errors[i] += 1
+            else:
+                counts[i] += 1
+                latencies[i].append(time.perf_counter() - t0)
+            j += 1
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - start
+    flat = [lat for per in latencies for lat in per]
+    return sum(counts), elapsed, flat, sum(errors)
+
+
+def _measure_interleaved(targets, clients, seconds, sizes, sample_shape,
+                         round_s=0.5):
+    """Alternate short closed-loop windows across ``targets`` (a dict of
+    name → callable) so slow drifts in background machine load hit every
+    path equally — the RATIO between paths is the published number, and
+    interleaving is what makes it stable on a shared box.  Returns
+    {name: {"rps", "latencies", "errors"}}."""
+    rounds = max(1, int(round(seconds / round_s)))
+    acc = {name: {"n": 0, "t": 0.0, "latencies": [], "errors": 0}
+           for name in targets}
+    for _ in range(rounds):
+        for name, target in targets.items():
+            n, t, lat, err = _closed_loop(
+                target, clients, seconds / rounds, sizes, sample_shape)
+            a = acc[name]
+            a["n"] += n
+            a["t"] += t
+            a["latencies"].extend(lat)
+            a["errors"] += err
+    for a in acc.values():
+        a["rps"] = a["n"] / a["t"] if a["t"] else 0.0
+    return acc
+
+
+def _open_loop(submit, offered_rps, seconds, sizes, sample_shape):
+    """Paced arrivals at ``offered_rps``; returns
+    (achieved_rps, latencies, shed)."""
+    from veles_tpu.serving import SchedulerOverflow
+    xs = {bs: numpy.random.RandomState(bs).uniform(
+        -1, 1, (bs,) + tuple(sample_shape)).astype(numpy.float32)
+        for bs in sizes}
+    latencies, shed, done = [], [0], [0]
+    lock = threading.Lock()
+    interval = 1.0 / offered_rps
+    threads = []
+    start = time.perf_counter()
+    n_arrivals = int(offered_rps * seconds)
+    def fire(x):
+        t0 = time.perf_counter()
+        try:
+            submit(x)
+        except SchedulerOverflow:
+            with lock:
+                shed[0] += 1
+        except Exception:
+            with lock:
+                shed[0] += 1
+        else:
+            with lock:
+                done[0] += 1
+                latencies.append(time.perf_counter() - t0)
+    for k in range(n_arrivals):
+        due = start + k * interval
+        delay = due - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(
+            target=fire, args=(xs[sizes[k % len(sizes)]],))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - start
+    return done[0] / elapsed, latencies, shed[0]
+
+
+def _quantiles_ms(latencies):
+    if not latencies:
+        return {}
+    ordered = sorted(latencies)
+    pick = lambda q: ordered[min(len(ordered) - 1,  # noqa: E731
+                                 int(q * len(ordered)))]
+    return {"p50_ms": round(pick(0.50) * 1e3, 3),
+            "p95_ms": round(pick(0.95) * 1e3, 3),
+            "p99_ms": round(pick(0.99) * 1e3, 3)}
+
+
+def _http_closed_loop(port, clients, seconds, sizes, sample_shape,
+                      route="/api"):
+    """Closed loop over persistent HTTP/1.1 connections."""
+    bodies = {bs: json.dumps({"input": numpy.random.RandomState(bs).uniform(
+        -1, 1, (bs,) + tuple(sample_shape)).round(4).tolist()}).encode()
+        for bs in sizes}
+    def mkconn():
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+        conn.connect()
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return conn
+    def make_target():
+        state = {"conn": mkconn()}
+        def post(body):
+            try:
+                state["conn"].request(
+                    "POST", route, body,
+                    {"Content-Type": "application/json"})
+                resp = state["conn"].getresponse()
+                resp.read()
+                if resp.status != 200:
+                    raise RuntimeError("HTTP %d" % resp.status)
+            except (http.client.HTTPException, ConnectionError, OSError):
+                state["conn"].close()
+                state["conn"] = mkconn()
+                raise
+        return post
+    # each client thread owns one connection: route through a
+    # thread-local-ish trick — target receives the prebuilt body
+    locals_ = [make_target() for _ in range(clients)]
+    latencies = [[] for _ in range(clients)]
+    counts = [0] * clients
+    errors = [0] * clients
+    start = time.perf_counter()
+    stop = start + seconds
+    def client(i):
+        post = locals_[i]
+        j = i
+        while time.perf_counter() < stop:
+            body = bodies[sizes[j % len(sizes)]]
+            t0 = time.perf_counter()
+            try:
+                post(body)
+            except Exception:
+                errors[i] += 1
+            else:
+                counts[i] += 1
+                latencies[i].append(time.perf_counter() - t0)
+            j += 1
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - start
+    flat = [lat for per in latencies for lat in per]
+    return sum(counts) / elapsed, flat, sum(errors)
+
+
+def run_bench(package=None, clients=8, seconds=2.0, sizes=DEFAULT_SIZES,
+              max_batch=64, transport="both", offered_rps=None,
+              open_seconds=None, keep_package=False):
+    """Run the comparison; returns the result dict (see module doc)."""
+    from veles_tpu.export.loader import PackageLoader
+    from veles_tpu.serving import BucketScheduler
+
+    tmp = None
+    if package is None:
+        tmp = tempfile.mkdtemp(prefix="serve_bench_")
+        package = build_mnist_package(os.path.join(tmp, "mnist_pkg.zip"))
+    loader = PackageLoader(package)
+    sample_shape = tuple(loader.model_metadata["input"]["sample_shape"])
+
+    out = {"clients": clients, "seconds": seconds,
+           "batch_sizes": list(sizes), "max_batch": max_batch,
+           "package": os.path.basename(package)}
+
+    # -- closed loop: seed per-request path vs the bucketed scheduler --------
+    # the baseline IS the seed RESTfulAPI dispatch (restful_api.py at
+    # the seed): one PackageLoader.run per request; the serving path is
+    # the scheduler's request interface (submit → batched executable)
+    seed_infer = lambda x: numpy.asarray(loader.run(x))  # noqa: E731
+    seed_infer(numpy.zeros((1,) + sample_shape, numpy.float32))  # warm
+    scheduler = BucketScheduler(loader, max_batch=max_batch,
+                                queue_limit=max(4 * clients, 64),
+                                name="serve_bench")
+    assert max(sizes) <= max_batch, "request sizes must fit max_batch"
+    sched_infer = lambda x: scheduler.submit(x).result()  # noqa: E731
+    try:
+        _closed_loop(seed_infer, 2, 0.15, sizes, sample_shape)
+        _closed_loop(sched_infer, 2, 0.15, sizes, sample_shape)
+        measured = _measure_interleaved(
+            {"per_request": seed_infer, "serve": sched_infer},
+            clients, seconds, sizes, sample_shape)
+        base, serve = measured["per_request"], measured["serve"]
+        out["per_request_rps"] = round(base["rps"], 1)
+        out["per_request_errors"] = base["errors"]
+        out.update({"per_request_" + k: v
+                    for k, v in _quantiles_ms(base["latencies"]).items()})
+        stats = scheduler.stats()
+        out["serve_rps"] = round(serve["rps"], 1)
+        out["serve_errors"] = serve["errors"]
+        out.update({"serve_" + k: v
+                    for k, v in _quantiles_ms(serve["latencies"]).items()})
+        out["serve_speedup_vs_per_request"] = round(
+            serve["rps"] / base["rps"], 2) if base["rps"] else None
+        out["compiles"] = stats["compiles"]
+        out["warmup_compiles"] = stats["warmup_compiles"]
+        out["post_warmup_compiles"] = stats["post_warmup_compiles"]
+        out["jit_cache_size"] = stats["jit_cache_size"]
+        out["buckets"] = stats["buckets"]
+        snap = scheduler.metrics.snapshot()
+        out["batch_fill"] = snap["batch_fill"]
+        out["rows_per_batch"] = snap["rows_per_batch"]
+
+        if offered_rps:
+            achieved, open_lat, shed = _open_loop(
+                scheduler.infer, offered_rps,
+                open_seconds or seconds, sizes, sample_shape)
+            out["offered_rps"] = offered_rps
+            out["serve_open_rps"] = round(achieved, 1)
+            out["serve_open_shed"] = shed
+            out.update({"serve_open_" + k: v
+                        for k, v in _quantiles_ms(open_lat).items()})
+    finally:
+        scheduler.close(drain=True)
+
+    # -- end-to-end HTTP -----------------------------------------------------
+    if transport in ("http", "both"):
+        from veles_tpu.serving import InferenceServer
+        server = InferenceServer({"mnist": package},
+                                 max_batch=max_batch,
+                                 queue_limit=max(4 * clients, 64))
+        try:
+            _http_closed_loop(server.port, 2, min(0.3, seconds), sizes,
+                              sample_shape)
+            http_rps, http_lat, http_err = _http_closed_loop(
+                server.port, clients, seconds, sizes, sample_shape)
+            out["serve_http_rps"] = round(http_rps, 1)
+            out["serve_http_errors"] = http_err
+            out.update({"serve_http_" + k: v
+                        for k, v in _quantiles_ms(http_lat).items()})
+        finally:
+            server.stop()
+
+    if tmp and not keep_package:
+        try:
+            os.unlink(package)
+            os.rmdir(tmp)
+        except OSError:
+            pass
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="serve_bench",
+        description="Inference-serving load generator (closed + open "
+                    "loop) for the veles_tpu.serving subsystem.")
+    p.add_argument("--package", default=None,
+                   help="exported package zip (default: build an "
+                        "initialized MNIST package in a temp dir)")
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--seconds", type=float, default=2.0,
+                   help="closed-loop measurement window per path")
+    p.add_argument("--batch-sizes", default="1,2,3,5,8",
+                   help="comma list of request batch sizes to mix")
+    p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--transport", default="both",
+                   choices=("inproc", "http", "both"),
+                   help="inproc: scheduler vs seed dispatch paths only; "
+                        "http: also the full server end to end")
+    p.add_argument("--smoke", action="store_true",
+                   help="short windows (~1 s each), inproc only — the "
+                        "tier-1 regression mode")
+    p.add_argument("--sustained", action="store_true",
+                   help="longer windows + paced open-loop arrivals "
+                        "(the slow-marked load test)")
+    p.add_argument("--offered-rps", type=float, default=None,
+                   help="open-loop arrival rate (default in --sustained: "
+                        "half the measured closed-loop serve_rps)")
+    p.add_argument("--json", action="store_true",
+                   help="print only the final JSON line")
+    args = p.parse_args(argv)
+
+    kwargs = dict(
+        package=args.package, clients=args.clients,
+        seconds=args.seconds, max_batch=args.max_batch,
+        sizes=tuple(int(s) for s in args.batch_sizes.split(",")),
+        transport=args.transport, offered_rps=args.offered_rps)
+    if args.smoke:
+        kwargs.update(seconds=min(args.seconds, 1.0), transport="inproc")
+    if args.sustained:
+        kwargs.update(seconds=max(args.seconds, 4.0), transport="both")
+        if kwargs["offered_rps"] is None:
+            kwargs["offered_rps"] = 200.0
+        kwargs["open_seconds"] = max(args.seconds, 4.0)
+
+    out = run_bench(**kwargs)
+    line = {"metric": "serve_rps", "value": out.get("serve_rps"),
+            "unit": "req/s"}
+    line.update(out)
+    if not args.json:
+        print("serving bench: %s req/s bucketed vs %s req/s seed "
+              "per-request path (%sx), batch fill %s, "
+              "%s compiles (all warmup)"
+              % (out.get("serve_rps"), out.get("per_request_rps"),
+                 out.get("serve_speedup_vs_per_request"),
+                 out.get("batch_fill"), out.get("compiles")),
+              file=sys.stderr)
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -196,6 +196,28 @@ def make_parser():
     p.add_argument("--ensemble-test", default=None, metavar="FILE.json",
                    help="averaged-probability inference over the "
                         "ensemble train output JSON")
+    p.add_argument("--serve", action="append", default=[],
+                   metavar="PKG.zip[:NAME]", dest="serve",
+                   help="serve exported package(s) over HTTP with "
+                        "dynamic batching instead of training "
+                        "(repeatable; NAME defaults to the file stem); "
+                        "see veles_tpu.serving")
+    p.add_argument("--serve-port", type=int, default=8080,
+                   help="inference server port (default 8080)")
+    p.add_argument("--serve-hostname", default="127.0.0.1",
+                   help="inference server bind address (loopback "
+                        "default keeps the models private)")
+    p.add_argument("--serve-max-batch", type=int, default=64,
+                   help="largest request batch bucket (power-of-two "
+                        "ladder compiled at startup)")
+    p.add_argument("--serve-queue-limit", type=int, default=256,
+                   help="outstanding-request bound; beyond it requests "
+                        "are shed with HTTP 429")
+    p.add_argument("--serve-workers", type=int, default=1,
+                   help="dispatch worker threads per model")
+    p.add_argument("--serve-seconds", type=float, default=None,
+                   help="serve for N seconds then drain and exit "
+                        "(default: until SIGINT; smoke tests/CI)")
     p.add_argument("--frontend", action="store_true",
                    help="interactive wizard: answer prompts, get the "
                         "generated command line, run it (reference "
@@ -300,6 +322,8 @@ class Main:
     # -- entry ---------------------------------------------------------------
     def run(self):
         args = self.args
+        if args.serve:
+            return self._run_serve()
         if args.frontend:
             return self._run_frontend()
         if args.config is not None and "=" in args.config \
@@ -371,6 +395,53 @@ class Main:
             return 1  # unit queue drained without reaching the end point
         return 0
 
+
+    def _run_serve(self, output=print):
+        """``--serve pkg.zip`` mode: stand up the dynamic-batching
+        inference server on the exported package(s) and block until
+        SIGINT (or ``--serve-seconds``), then drain gracefully.  The
+        train-side flags don't apply; ``--backend`` still picks the
+        JAX platform the executables compile for."""
+        args = self.args
+        if args.workflow:
+            raise SystemExit("--serve serves exported packages; drop "
+                             "the workflow argument (train first, "
+                             "export with veles_tpu.export, then serve "
+                             "the package zip)")
+        if args.backend and args.backend not in ("auto", "numpy"):
+            import jax
+            jax.config.update("jax_platforms", args.backend)
+        from .serving import InferenceServer
+        models = []
+        for spec in args.serve:
+            path, _, name = spec.partition(":")
+            if not name:
+                name = os.path.splitext(os.path.basename(path))[0]
+            models.append((name, path))
+        # models register (and warmup-compile their bucket ladders)
+        # BEFORE the socket opens: the first request ever seen is
+        # already warm, and /healthz never advertises an empty server
+        server = InferenceServer(
+            models, port=args.serve_port, host=args.serve_hostname,
+            max_batch=args.serve_max_batch,
+            queue_limit=args.serve_queue_limit,
+            workers=args.serve_workers)
+        try:
+            for name, path in models:
+                entry = server.registry.get(name)
+                output("serving %r from %s  (buckets %s)  POST %s/api/%s"
+                       % (name, path, entry.scheduler.buckets,
+                          server.url, name))
+            output("endpoints: POST %s/api  ·  GET %s/healthz  ·  "
+                   "GET %s/metrics" % (server.url, server.url, server.url))
+            try:
+                import threading
+                threading.Event().wait(args.serve_seconds)
+            except KeyboardInterrupt:
+                output("draining...")
+        finally:
+            server.stop(drain=True)
+        return 0
 
     def _run_frontend(self, input_fn=input, output=print):
         """Terminal wizard: prompt for the run's pieces, print the

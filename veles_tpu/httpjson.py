@@ -1,9 +1,16 @@
 """Shared JSON-over-HTTP plumbing for the serving endpoints.
 
-Both the standalone inference endpoint (restful_api.py) and the
-live-workflow input loader (loader/restful.py) speak the same protocol —
-``POST /api {"input": ...}`` answered with JSON — so the request
-parsing/validation and response writing live here once.
+The serving subsystem (serving/server.py), the compatibility facade
+(restful_api.py) and the live-workflow input loader (loader/restful.py)
+all speak the same protocol — ``POST /api {"input": ...}`` answered with
+JSON — so the request parsing/validation and response writing live here
+once.
+
+Error taxonomy: everything wrong with the *request* raises
+:class:`ClientError` (a ValueError), which handlers answer with HTTP
+400; any other exception is a *server* fault and must surface as a 500
+with a generic body — never the raw traceback string (the seed handler
+conflated the two, restful_api.py:87-88).
 """
 
 import json
@@ -12,25 +19,38 @@ from http.server import BaseHTTPRequestHandler
 import numpy
 
 
+class ClientError(ValueError):
+    """The request itself is malformed — answer 400, not 500."""
+
+
 class JsonRequestHandler(BaseHTTPRequestHandler):
     """Quiet handler with JSON helpers and the /api input contract."""
 
     def log_message(self, *args):
         pass
 
-    def send_json(self, code, payload):
+    def send_json(self, code, payload, headers=None):
         data = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(data)
 
     def read_input_payload(self):
         """Parse the request body as {"input": ...} → float32 array.
-        Raises ValueError with a client-presentable message."""
+        Raises ClientError with a client-presentable message."""
         length = int(self.headers.get("Content-Length", 0))
-        payload = json.loads(self.rfile.read(length))
+        try:
+            payload = json.loads(self.rfile.read(length))
+        except ValueError:
+            raise ClientError("body is not valid JSON")
         if not isinstance(payload, dict) or "input" not in payload:
-            raise ValueError("body must be {'input': [...]}")
-        return numpy.asarray(payload["input"], numpy.float32)
+            raise ClientError("body must be {'input': [...]}")
+        try:
+            return numpy.asarray(payload["input"], numpy.float32)
+        except (ValueError, TypeError):
+            raise ClientError("'input' is not a numeric array "
+                              "(ragged or non-numeric rows?)")

@@ -1,88 +1,59 @@
 """REST inference endpoint: POST a sample, get the model's answer.
 
-Re-creation of /root/reference/veles/restful_api.py (:78-217): the
-reference ran a Twisted site inside the training process, fed the
-loader's minibatch Arrays, re-ran the forward part of the graph per
-request, and applied an ``evaluation_transform`` callback to the output.
-Here the endpoint compiles the forward chain ONCE into a jitted callable
-(batch-1 XLA executable, reused every request) and serves it from a
-stdlib ThreadingHTTPServer daemon thread; it can wrap a live workflow
-*or* an exported package (PackageLoader), so serving does not require
-the training process.
+Re-creation of /root/reference/veles/restful_api.py (:78-217), now a
+thin compatibility facade over :mod:`veles_tpu.serving`.  The seed
+implementation compiled ONE batch-1 executable and dispatched it per
+request — a client posting any other batch size triggered a silent
+recompile, and every exception (including server-side inference
+failures) came back as HTTP 400 with the raw error string.  The facade
+keeps the constructor, the ``/api`` protocol and the
+``evaluation_transform`` hook, but routes everything through the
+bucketed dynamic-batching scheduler: any batch size lands on a warm
+power-of-two executable, malformed payloads get 400, server faults get
+a traceback-free 500, overload gets 429.
 
 Protocol (reference-compatible shape):
     POST /api  {"input": [[...sample...], ...]}
     → {"result": [...], "output": [[...]]}
-"""
 
-import threading
-from http.server import ThreadingHTTPServer
+New deployments should use :class:`veles_tpu.serving.InferenceServer`
+directly (multi-model routing, /metrics, /healthz); this class remains
+the one-model one-liner.
+"""
 
 import numpy
 
-from .httpjson import JsonRequestHandler
+from .serving import InferenceServer
 
 
 class RESTfulAPI:
-    """Serve a trained model over HTTP."""
+    """Serve a trained model over HTTP (single-model facade)."""
 
     def __init__(self, model, port=0, evaluation_transform=None,
-                 host="127.0.0.1"):
+                 host="127.0.0.1", **scheduler_kwargs):
         """``model``: a StandardWorkflow (live forwards) or a
         PackageLoader / path to a package zip.  ``host``: bind address —
         the loopback default keeps the model private; pass "0.0.0.0" to
         serve off-host (the reference served on all interfaces,
-        restful_api.py:78)."""
-        self._transform = evaluation_transform
-        self._infer = self._build_infer(model)
-        handler = type("Handler", (_Handler,), {"api": self})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
-            name="veles-tpu-rest")
-        self._thread.start()
-
-    def _build_infer(self, model):
-        import jax
-        if isinstance(model, str):
-            from .export.loader import PackageLoader
-            model = PackageLoader(model)
-        if hasattr(model, "run") and hasattr(model, "unit_params"):
-            return lambda x: numpy.asarray(model.run(x))  # PackageLoader
-        from .export.model import forward_fn
-        jitted = jax.jit(forward_fn(model.forwards))
-        params = [f.params for f in model.forwards]
-        return lambda x: numpy.asarray(jitted(params, x))
+        restful_api.py:78).  Extra kwargs tune the scheduler
+        (``max_batch``, ``queue_limit``, ``workers``)."""
+        self.server = InferenceServer(port=port, host=host,
+                                      **scheduler_kwargs)
+        self.server.registry.add("default", model,
+                                 transform=evaluation_transform)
+        self.port = self.server.port
 
     def infer(self, batch):
-        x = numpy.asarray(batch, numpy.float32)
-        out = self._infer(x)
-        if self._transform is not None:
-            result = self._transform(out)
-        elif out.ndim == 2 and out.shape[1] > 1:
-            result = out.argmax(axis=1).tolist()  # classifier default
-        else:
-            result = out.tolist()
-        return result, out
+        """In-process inference through the same batched path the HTTP
+        handlers use; returns the (result, output-array) tuple."""
+        batch = numpy.asarray(batch, numpy.float32)
+        if batch.ndim == 1:
+            batch = batch[None]
+        return self.server.registry.get("default").infer(batch)
+
+    def stats(self):
+        """Scheduler cache/queue stats (compiles, buckets, depth)."""
+        return self.server.registry.get("default").scheduler.stats()
 
     def stop(self):
-        self._httpd.shutdown()
-        self._httpd.server_close()
-
-
-class _Handler(JsonRequestHandler):
-    api = None
-
-    def do_POST(self):
-        if self.path != "/api":
-            self.send_json(404, {"error": "not found"})
-            return
-        try:
-            batch = self.read_input_payload()
-            if batch.ndim == 1:
-                batch = batch[None]  # single sample convenience
-            result, out = self.api.infer(batch)
-            self.send_json(200, {"result": result, "output": out.tolist()})
-        except Exception as e:  # client errors must get a JSON answer
-            self.send_json(400, {"error": str(e)})
+        self.server.stop()

@@ -1,0 +1,34 @@
+"""veles_tpu.serving — dynamic-batching inference service.
+
+The TPU-native counterpart of the reference's standalone inference
+runtime (libVeles beside the trainer): turn a trained workflow or an
+exported package into a production HTTP service.
+
+- :mod:`.scheduler` — micro-batching onto warm, shape-bucketed XLA
+  executables (power-of-two padding, AOT warmup, zero steady-state
+  recompilation, bounded-queue backpressure);
+- :mod:`.registry` — several named, hot-loadable models per server;
+- :mod:`.server` — the HTTP front end (429 load shedding, graceful
+  drain, ``/metrics`` + ``/healthz``);
+- :mod:`.metrics` — latency histograms, batch-fill, req/s, wired into
+  the Chrome-trace event log.
+
+Quickstart::
+
+    from veles_tpu.serving import InferenceServer
+    server = InferenceServer({"mnist": "mnist_pkg.zip"}, port=8080)
+    # POST http://127.0.0.1:8080/api/mnist {"input": [[...784...]]}
+    server.stop()
+
+or from the CLI: ``python -m veles_tpu --serve mnist_pkg.zip``.
+"""
+
+from .metrics import LatencyWindow, ServingMetrics
+from .registry import ModelRegistry, ServedModel
+from .scheduler import (BucketScheduler, SchedulerClosed,
+                        SchedulerOverflow, bucket_sizes)
+from .server import InferenceServer
+
+__all__ = ["BucketScheduler", "InferenceServer", "LatencyWindow",
+           "ModelRegistry", "ServedModel", "SchedulerClosed",
+           "SchedulerOverflow", "ServingMetrics", "bucket_sizes"]

@@ -1,0 +1,144 @@
+"""Serving observability: per-model latency histograms and throughput.
+
+The training side already streams Chrome-trace events through
+``logger.EventLog`` (logger.py:86); the serving side plugs into the same
+channel — every executed batch becomes a ``serving.batch`` span, every
+shed request a ``serving.reject`` instant — so one Perfetto timeline
+shows minibatches and inference batches side by side.  On top of that,
+:class:`ServingMetrics` keeps the aggregate numbers a load balancer or
+dashboard polls from ``GET /metrics``: request/row counts, p50/p95/p99
+latency over a sliding window, queue depth, batch-fill ratio (real rows
+vs padded rows — the price of power-of-two bucketing), and req/s both
+lifetime and over the recent window.
+"""
+
+import collections
+import threading
+import time
+
+from ..logger import events
+
+
+class LatencyWindow:
+    """Sliding-window latency reservoir with tail quantiles.
+
+    A bounded deque of the most recent ``window`` observations: cheap to
+    record under load (append + O(1) eviction), exact quantiles over the
+    window when summarized (sort cost paid by the /metrics reader, not
+    the request path).
+    """
+
+    def __init__(self, window=4096):
+        self._samples = collections.deque(maxlen=int(window))
+        self._lock = threading.Lock()
+
+    def record(self, seconds):
+        with self._lock:
+            self._samples.append(float(seconds))
+
+    @staticmethod
+    def _quantile(ordered, q):
+        if not ordered:
+            return None
+        idx = min(len(ordered) - 1, int(q * len(ordered)))
+        return ordered[idx]
+
+    def summary(self):
+        with self._lock:
+            ordered = sorted(self._samples)
+        if not ordered:
+            return {"n": 0, "p50_ms": None, "p95_ms": None, "p99_ms": None}
+        to_ms = lambda s: round(s * 1e3, 3)  # noqa: E731
+        return {"n": len(ordered),
+                "p50_ms": to_ms(self._quantile(ordered, 0.50)),
+                "p95_ms": to_ms(self._quantile(ordered, 0.95)),
+                "p99_ms": to_ms(self._quantile(ordered, 0.99)),
+                "mean_ms": to_ms(sum(ordered) / len(ordered)),
+                "max_ms": to_ms(ordered[-1])}
+
+
+class ServingMetrics:
+    """Aggregate serving counters for one model.
+
+    Thread-safe; recorded from request threads and the dispatch worker,
+    read by ``GET /metrics``.  Counter semantics:
+
+    - ``requests`` / ``rows``: completed inferences (a request may carry
+      several sample rows);
+    - ``failures``: requests answered with an internal error;
+    - ``rejected``: requests shed by backpressure (HTTP 429);
+    - ``batches`` / ``batch_rows`` / ``padded_rows``: dispatch-side view —
+      fill ratio = batch_rows / (batch_rows + padded_rows).
+    """
+
+    RATE_WINDOW = 2048  # completion timestamps kept for the recent-rps view
+
+    def __init__(self, model="default"):
+        self.model = model
+        self.latency = LatencyWindow()
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+        self.requests = 0
+        self.rows = 0
+        self.failures = 0
+        self.rejected = 0
+        self.batches = 0
+        self.batch_rows = 0
+        self.padded_rows = 0
+        self._completions = collections.deque(maxlen=self.RATE_WINDOW)
+
+    # -- request-side --------------------------------------------------------
+    def record_request(self, rows, seconds, ok=True):
+        self.latency.record(seconds)
+        with self._lock:
+            self.requests += 1
+            self.rows += int(rows)
+            if not ok:
+                self.failures += 1
+            self._completions.append(time.time())
+
+    def record_reject(self):
+        with self._lock:
+            self.rejected += 1
+        events.event("serving.reject", model=self.model)
+
+    # -- dispatch-side -------------------------------------------------------
+    def record_batch(self, bucket, rows, seconds, n_requests):
+        with self._lock:
+            self.batches += 1
+            self.batch_rows += int(rows)
+            self.padded_rows += int(bucket) - int(rows)
+        events.span("serving.batch", seconds, model=self.model,
+                    bucket=int(bucket), rows=int(rows),
+                    requests=int(n_requests))
+
+    # -- reader --------------------------------------------------------------
+    def snapshot(self):
+        now = time.time()
+        with self._lock:
+            completions = list(self._completions)
+            counters = {"requests": self.requests, "rows": self.rows,
+                        "failures": self.failures, "rejected": self.rejected,
+                        "batches": self.batches,
+                        "batch_rows": self.batch_rows,
+                        "padded_rows": self.padded_rows}
+        uptime = max(now - self._t0, 1e-9)
+        recent_rps = None
+        if len(completions) >= 2:
+            span = completions[-1] - completions[0]
+            if span > 0:
+                recent_rps = round((len(completions) - 1) / span, 1)
+        filled = counters["batch_rows"]
+        padded = counters["padded_rows"]
+        out = dict(counters)
+        out.update({
+            "uptime_s": round(uptime, 1),
+            "lifetime_rps": round(counters["requests"] / uptime, 2),
+            "recent_rps": recent_rps,
+            "batch_fill": round(filled / (filled + padded), 4)
+            if filled + padded else None,
+            "rows_per_batch": round(filled / counters["batches"], 2)
+            if counters["batches"] else None,
+            "latency": self.latency.summary(),
+        })
+        return out

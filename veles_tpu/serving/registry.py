@@ -1,0 +1,139 @@
+"""Multi-model registry: one server, several named models.
+
+The reference deployment story put one model behind one Twisted site
+(restful_api.py:78); a production box serves many.  The registry maps
+``name -> ServedModel`` (a :class:`BucketScheduler` plus the
+result-shaping transform), hot-loadable from exported package zips at
+runtime (``POST /api/<name>`` routes here), with the first — or an
+explicitly flagged — entry as the default for bare ``POST /api``.
+"""
+
+import threading
+import time
+
+from .metrics import ServingMetrics
+from .scheduler import BucketScheduler
+
+
+class ServedModel:
+    """One registry entry: scheduler + answer shaping.
+
+    ``transform`` plays the reference's ``evaluation_transform`` role
+    (restful_api.py evaluation hook); without it a 2-D multi-column
+    output is argmaxed (classifier convention), anything else is
+    returned verbatim.
+    """
+
+    def __init__(self, name, scheduler, transform=None, source=None):
+        self.name = name
+        self.scheduler = scheduler
+        self.transform = transform
+        self.source = source
+        self.created = time.time()
+
+    def infer(self, batch, timeout=None):
+        """→ (result, output) — the protocol tuple the handlers serve."""
+        out = self.scheduler.infer(batch, timeout=timeout)
+        if self.transform is not None:
+            result = self.transform(out)
+        elif out.ndim == 2 and out.shape[1] > 1:
+            result = out.argmax(axis=1).tolist()
+        else:
+            result = out.tolist()
+        return result, out
+
+    def describe(self):
+        stats = self.scheduler.stats()
+        return {"source": self.source,
+                "sample_shape": list(self.scheduler.sample_shape)
+                if self.scheduler.sample_shape is not None else None,
+                "buckets": stats["buckets"],
+                "queue_depth": stats["queue_depth"],
+                "queue_limit": stats["queue_limit"]}
+
+
+class ModelRegistry:
+    """Thread-safe name → :class:`ServedModel` map."""
+
+    def __init__(self, **scheduler_defaults):
+        self._models = {}
+        self._order = []
+        self._default = None
+        self._lock = threading.Lock()
+        self._scheduler_defaults = scheduler_defaults
+
+    def add(self, name, model, transform=None, default=False,
+            metrics=None, **scheduler_kwargs):
+        """Register a model (workflow / package path / PackageLoader /
+        callable) under ``name``; compiles its bucket ladder now so the
+        first request is already warm."""
+        source = model if isinstance(model, str) else type(model).__name__
+        kwargs = dict(self._scheduler_defaults)
+        kwargs.update(scheduler_kwargs)
+        scheduler = BucketScheduler(
+            model, name=name,
+            metrics=metrics or ServingMetrics(name), **kwargs)
+        entry = ServedModel(name, scheduler, transform=transform,
+                            source=source)
+        with self._lock:
+            prior = self._models.get(name)
+            self._models[name] = entry
+            if name not in self._order:
+                self._order.append(name)
+            if default or self._default is None:
+                self._default = name
+        if prior is not None:     # hot swap: drain the replaced scheduler
+            prior.scheduler.close(drain=True)
+        return entry
+
+    def load_package(self, name, path, **kwargs):
+        """Hot-load an exported package zip under ``name``."""
+        return self.add(name, str(path), **kwargs)
+
+    def remove(self, name, drain=True):
+        with self._lock:
+            entry = self._models.pop(name, None)
+            if name in self._order:
+                self._order.remove(name)
+            if self._default == name:
+                self._default = self._order[0] if self._order else None
+        if entry is not None:
+            entry.scheduler.close(drain=drain)
+        return entry is not None
+
+    def get(self, name):
+        with self._lock:
+            return self._models.get(name)
+
+    def resolve(self, name=None):
+        """``None``/empty → the default entry; unknown → None."""
+        with self._lock:
+            if not name:
+                name = self._default
+            return self._models.get(name) if name else None
+
+    def names(self):
+        with self._lock:
+            return list(self._order)
+
+    @property
+    def default_name(self):
+        return self._default
+
+    def describe(self):
+        with self._lock:
+            entries = list(self._models.items())
+        return {name: entry.describe() for name, entry in entries}
+
+    def metrics_snapshot(self):
+        with self._lock:
+            entries = list(self._models.items())
+        return {name: {**entry.scheduler.metrics.snapshot(),
+                       **entry.scheduler.stats()}
+                for name, entry in entries}
+
+    def close(self, drain=True):
+        with self._lock:
+            entries = list(self._models.values())
+        for entry in entries:
+            entry.scheduler.close(drain=drain)

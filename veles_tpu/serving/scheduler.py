@@ -1,0 +1,422 @@
+"""Dynamic micro-batching over shape-bucketed XLA executables.
+
+The seed serving path (restful_api.py) paid one XLA dispatch — and, for
+exported packages, one ``jax.export`` call-wrapper rebuild — per HTTP
+request.  This module amortizes both the way the TPU-inference
+literature does (Ragged Paged Attention, PAPERS.md: pad to buckets,
+serve every bucket from one compiled program; TVM, PAPERS.md:
+ahead-of-time compiled end-to-end serving):
+
+- concurrent requests are concatenated into one batch and **padded to
+  the next power-of-two bucket**, so the steady state only ever sees
+  ``log2(max_batch)+1`` distinct shapes;
+- every bucket is **AOT-compiled once at startup**
+  (``jax.jit(...).lower(...).compile()``) — warm executables, zero
+  recompilation after warmup, asserted via :meth:`BucketScheduler.stats`;
+- batching is **continuous** (vLLM-style): a dispatch worker drains
+  whatever is queued and executes immediately — while a batch runs, the
+  next one accumulates; no fixed batching window adds latency;
+- backpressure is a bounded count of outstanding requests: when full,
+  :meth:`submit` raises :class:`SchedulerOverflow` and the server
+  answers 429 instead of letting the queue grow without bound.
+
+Works on any JAX backend; on the tunneled TPU the per-dispatch RTT
+(~14 ms, docs/PERF.md) makes batching amortization strictly larger than
+the CPU numbers recorded by tools/serve_bench.py.
+"""
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy
+
+from ..logger import events
+from .metrics import ServingMetrics
+
+
+class SchedulerOverflow(RuntimeError):
+    """The bounded request queue is full — shed load (HTTP 429)."""
+
+
+class SchedulerClosed(RuntimeError):
+    """The scheduler is draining or stopped — no new requests."""
+
+
+def bucket_sizes(max_batch):
+    """The power-of-two bucket ladder: 1, 2, 4, ... max_batch."""
+    if max_batch < 1:
+        raise ValueError("max_batch must be >= 1")
+    sizes, b = [], 1
+    while b < max_batch:
+        sizes.append(b)
+        b <<= 1
+    sizes.append(int(max_batch))  # top bucket even when not a power of two
+    return sizes
+
+
+# -- model adapters ----------------------------------------------------------
+# One scheduler serves any of: a live StandardWorkflow (its forward
+# chain), an exported package (PackageLoader / path to the zip), or an
+# opaque python callable (tests, custom runtimes).
+
+
+class JaxModel:
+    """A pure ``fn(params, x)`` compiled per bucket via jax.jit AOT."""
+
+    def __init__(self, fn, params, sample_shape):
+        import jax
+        self._jit = jax.jit(fn)
+        # params live on device once; per-dispatch host->device traffic
+        # is the padded batch only
+        self._params = jax.device_put(params)
+        self.sample_shape = tuple(int(d) for d in sample_shape)
+
+    def compile(self, bucket):
+        import jax
+        struct = jax.ShapeDtypeStruct((int(bucket),) + self.sample_shape,
+                                      numpy.float32)
+        compiled = self._jit.lower(self._params, struct).compile()
+        params = self._params
+        return lambda xs: compiled(params, xs)
+
+    def jit_cache_size(self):
+        """Eager-jit cache entries — stays 0 when every call went
+        through a warm AOT executable (the zero-recompile assertion)."""
+        try:
+            return self._jit._cache_size()
+        except Exception:
+            return None
+
+
+class OpaqueModel:
+    """An opaque callable ``fn(x) -> y``; no compilation to manage."""
+
+    def __init__(self, fn, sample_shape=None):
+        self._fn = fn
+        self.sample_shape = (tuple(int(d) for d in sample_shape)
+                             if sample_shape is not None else None)
+
+    def compile(self, bucket):
+        return self._fn
+
+    def jit_cache_size(self):
+        return None
+
+
+def adapt_model(model, sample_shape=None):
+    """model → adapter with ``compile(bucket)`` + ``sample_shape``.
+
+    Accepts a package path, a PackageLoader, anything with a non-empty
+    ``forwards`` chain (StandardWorkflow), or a bare callable.
+    """
+    if isinstance(model, str):
+        from ..export.loader import PackageLoader
+        model = PackageLoader(model)
+    if hasattr(model, "deserialize") and hasattr(model, "unit_params"):
+        exported = model.deserialize()
+        meta = model.model_metadata
+        if meta is None:
+            raise ValueError("package has no model.json metadata")
+        return JaxModel(lambda p, x: exported.call(p, x),
+                        model.unit_params(),
+                        meta["input"]["sample_shape"])
+    forwards = getattr(model, "forwards", None)
+    if forwards:
+        from ..export.model import forward_fn
+        return JaxModel(forward_fn(forwards),
+                        [f.params for f in forwards],
+                        forwards[0].input.shape[1:])
+    if callable(model):
+        return OpaqueModel(model, sample_shape)
+    raise TypeError("cannot serve %r: want a package path, PackageLoader, "
+                    "a workflow with forwards, or a callable" % (model,))
+
+
+class _Pending:
+    __slots__ = ("x", "n", "future", "enqueued")
+
+    def __init__(self, x):
+        self.x = x
+        self.n = int(x.shape[0])
+        self.future = Future()
+        self.enqueued = time.perf_counter()
+
+
+_STOP = object()
+
+
+class BucketScheduler:
+    """Collect concurrent requests into padded power-of-two batches.
+
+    ``workers`` dispatch threads pull from one queue; each drains what
+    is available (continuous batching), pads to the smallest bucket
+    that fits, and runs that bucket's warm executable.  ``queue_limit``
+    bounds *outstanding* requests (queued + in a forming batch); beyond
+    it :meth:`submit` raises :class:`SchedulerOverflow`.
+    """
+
+    def __init__(self, model, max_batch=64, queue_limit=256, workers=1,
+                 max_wait=0.0, warmup=True, name="default",
+                 metrics=None, sample_shape=None):
+        self.name = name
+        self.max_batch = int(max_batch)
+        self.queue_limit = int(queue_limit)
+        self.max_wait = float(max_wait)
+        self.metrics = metrics or ServingMetrics(name)
+        self._adapter = adapt_model(model, sample_shape)
+        self.sample_shape = self._adapter.sample_shape
+        self.buckets = bucket_sizes(self.max_batch)
+        self._executables = {}
+        self._compiles = 0
+        self._warmup_compiles = 0
+        self._compile_lock = threading.Lock()
+        self._queue = queue.Queue()     # unbounded; bound enforced below
+        self._depth = 0                 # outstanding requests
+        self._depth_lock = threading.Lock()
+        self._closed = False
+        if warmup:
+            self.warmup()
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name="veles-serve-%s-%d" % (name, i))
+            for i in range(max(int(workers), 1))]
+        for t in self._workers:
+            t.start()
+
+    # -- compilation ---------------------------------------------------------
+    def warmup(self):
+        """Compile every bucket up front so steady state never compiles.
+
+        Buckets the model cannot take (a static-batch package artifact)
+        are dropped from the ladder instead of failing the whole model;
+        at least one bucket must survive.
+        """
+        usable = []
+        for b in self.buckets:
+            try:
+                self._get_executable(b)
+                usable.append(b)
+            except Exception as exc:
+                events.event("serving.warmup_skip", model=self.name,
+                             bucket=b, error=str(exc)[:200])
+        if not usable:
+            raise ValueError(
+                "model %r compiled for no bucket size" % self.name)
+        self.buckets = usable
+        self.max_batch = usable[-1]
+        self._warmup_compiles = self._compiles
+
+    def _get_executable(self, bucket):
+        run = self._executables.get(bucket)
+        if run is not None:
+            return run
+        with self._compile_lock:
+            run = self._executables.get(bucket)
+            if run is None:
+                t0 = time.perf_counter()
+                run = self._adapter.compile(bucket)
+                self._compiles += 1
+                self._executables[bucket] = run
+                events.span("serving.compile",
+                            time.perf_counter() - t0,
+                            model=self.name, bucket=int(bucket))
+        return run
+
+    def _bucket_for(self, rows):
+        for b in self.buckets:
+            if b >= rows:
+                return b
+        return self.buckets[-1]
+
+    # -- request side --------------------------------------------------------
+    def validate(self, x):
+        """Shape-check a request batch; raises ValueError (client error)."""
+        if x.ndim < 2:
+            raise ValueError("input must be a batch of samples")
+        if self.sample_shape is not None and \
+                tuple(x.shape[1:]) != self.sample_shape:
+            raise ValueError(
+                "sample shape %s does not match the model's %s"
+                % (list(x.shape[1:]), list(self.sample_shape)))
+
+    def submit(self, x):
+        """Enqueue one request batch (≤ max_batch rows) → Future of the
+        output rows.  Raises SchedulerOverflow / SchedulerClosed /
+        ValueError (bad shape)."""
+        x = numpy.ascontiguousarray(x, numpy.float32)
+        self.validate(x)
+        if x.shape[0] > self.max_batch:
+            raise ValueError("request of %d rows exceeds max_batch=%d "
+                             "(use infer(), which chunks)"
+                             % (x.shape[0], self.max_batch))
+        return self._enqueue(x)
+
+    def _enqueue(self, x):
+        """The validated hot path: bound check, depth accounting, queue."""
+        if self._closed:
+            raise SchedulerClosed("scheduler %r is shut down" % self.name)
+        with self._depth_lock:
+            if self._depth >= self.queue_limit:
+                self.metrics.record_reject()
+                raise SchedulerOverflow(
+                    "queue full (%d outstanding, limit %d)"
+                    % (self._depth, self.queue_limit))
+            self._depth += 1
+        req = _Pending(x)
+        self._queue.put(req)
+        return req.future
+
+    def infer(self, x, timeout=None):
+        """Blocking inference of any batch size: chunk to ≤ max_batch,
+        submit, concatenate.  Returns the output as a numpy array."""
+        x = numpy.ascontiguousarray(x, numpy.float32)
+        self.validate(x)
+        t0 = time.perf_counter()
+        futures = [self._enqueue(x[i:i + self.max_batch])
+                   for i in range(0, x.shape[0], self.max_batch)]
+        try:
+            parts = [f.result(timeout) for f in futures]
+        except Exception:
+            self.metrics.record_request(
+                x.shape[0], time.perf_counter() - t0, ok=False)
+            raise
+        out = parts[0] if len(parts) == 1 else numpy.concatenate(parts)
+        self.metrics.record_request(x.shape[0], time.perf_counter() - t0)
+        return out
+
+    # -- dispatch side -------------------------------------------------------
+    def _take_next(self, deadline):
+        try:
+            return self._queue.get_nowait()
+        except queue.Empty:
+            if deadline is None:
+                return None
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                return self._queue.get(timeout=remaining)
+            except queue.Empty:
+                return None
+
+    def _worker_loop(self):
+        carry = None
+        while True:
+            req = carry if carry is not None else self._queue.get()
+            carry = None
+            if req is _STOP:
+                return
+            batch, rows = [req], req.n
+            # optional linger (off by default): continuous batching
+            # self-clocks under load — while this batch runs, the next
+            # accumulates — so waiting only ever adds latency
+            deadline = (time.monotonic() + self.max_wait
+                        if self.max_wait > 0 else None)
+            while rows < self.max_batch:
+                nxt = self._take_next(deadline)
+                if nxt is None:
+                    break
+                if nxt is _STOP:
+                    carry = _STOP
+                    break
+                if rows + nxt.n > self.max_batch:
+                    carry = nxt     # starts the next batch
+                    break
+                batch.append(nxt)
+                rows += nxt.n
+            self._execute(batch, rows)
+
+    def _execute(self, batch, rows):
+        t0 = time.perf_counter()
+        try:
+            bucket = self._bucket_for(rows)
+            run = self._executables.get(bucket) or \
+                self._get_executable(bucket)
+            if len(batch) == 1 and batch[0].n == bucket:
+                xs = batch[0].x
+            else:
+                parts = [r.x for r in batch]
+                if bucket > rows:
+                    parts.append(numpy.zeros(
+                        (bucket - rows,) + batch[0].x.shape[1:],
+                        numpy.float32))
+                xs = numpy.concatenate(parts)
+            out = numpy.asarray(run(xs))
+        except Exception as exc:
+            for r in batch:
+                if not r.future.set_running_or_notify_cancel():
+                    continue
+                r.future.set_exception(exc)
+            self._release(len(batch))
+            return
+        off = 0
+        for r in batch:
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_result(out[off:off + r.n])
+            off += r.n
+        self._release(len(batch))
+        self.metrics.record_batch(bucket, rows,
+                                  time.perf_counter() - t0, len(batch))
+
+    def _release(self, n):
+        with self._depth_lock:
+            self._depth -= n
+
+    # -- lifecycle / introspection -------------------------------------------
+    def close(self, drain=True, timeout=10.0):
+        """Stop accepting requests; by default finish everything queued
+        (graceful drain), then stop the dispatch workers."""
+        if self._closed:
+            return
+        self._closed = True
+        if not drain:
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if req is _STOP:
+                    continue
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(
+                        SchedulerClosed("scheduler shut down"))
+                self._release(1)
+        for _ in self._workers:
+            self._queue.put(_STOP)
+        for t in self._workers:
+            t.join(timeout)
+        # a submit that raced the closed flag could still be queued with
+        # no worker left to serve it — fail it rather than hang its client
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is _STOP:
+                continue
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(
+                    SchedulerClosed("scheduler shut down"))
+            self._release(1)
+
+    @property
+    def queue_depth(self):
+        return self._depth
+
+    def stats(self):
+        """Executable-cache accounting — the zero-recompile evidence."""
+        return {
+            "buckets": list(self.buckets),
+            "executables": len(self._executables),
+            "compiles": self._compiles,
+            "warmup_compiles": self._warmup_compiles,
+            "post_warmup_compiles": self._compiles - self._warmup_compiles,
+            "jit_cache_size": self._adapter.jit_cache_size(),
+            "queue_depth": self._depth,
+            "queue_limit": self.queue_limit,
+            "max_batch": self.max_batch,
+            "workers": len(self._workers),
+            "closed": self._closed,
+        }
