@@ -573,6 +573,94 @@ def bench_serving(clients=8, seconds=2.0):
                 out.get("post_warmup_compiles")}
 
 
+def bench_observability(batch=512, steps=64, repeats=5):
+    """Tracing+metrics overhead on the MNIST per-step loop (ISSUE 2
+    acceptance: < 5%): the SAME per-launch step loop timed bare, then
+    with the full observability stack on — JSONL event tracing, the
+    process-global metrics registry, and the StepProfiler with its
+    block_until_ready fencing.  Interleaved A/B windows so shared-chip
+    contention drift cancels instead of biasing the ratio; the overhead
+    ratio uses per-window minima."""
+    import tempfile
+    from veles_tpu import loader as loader_mod
+    from veles_tpu.backends import Device
+    from veles_tpu.config import root
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.znicz.samples import mnist as mnist_sample
+
+    _stamp("observability stage: building mnist step loop")
+    wf = mnist_sample.create_workflow(
+        loader={"minibatch_size": batch, "n_train": 8 * batch,
+                "n_valid": batch, "use_fixture": False,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 10 ** 9, "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    step = wf.fused_step
+
+    def run_steps(n):
+        done = 0
+        while done < n:
+            wf.loader.run()
+            if wf.loader.minibatch_class == loader_mod.TRAIN:
+                step.run()
+                done += 1
+        _sync(step)
+
+    run_steps(steps)  # compile + warmup
+    run_steps(steps)
+
+    trace_file = tempfile.NamedTemporaryFile(
+        prefix="veles-obs-bench-", suffix=".jsonl", delete=False)
+    trace_file.close()
+    off_times, on_times = [], []
+    profiler = None
+    try:
+        for _ in range(repeats):
+            # bare window
+            t0 = time.perf_counter()
+            run_steps(steps)
+            off_times.append(time.perf_counter() - t0)
+            # instrumented window: tracing + registry + profiler
+            root.common.trace.enabled = True
+            root.common.trace.file = trace_file.name
+            profiler = wf.attach_profiler()
+            t0 = time.perf_counter()
+            run_steps(steps)
+            on_times.append(time.perf_counter() - t0)
+            profiler.detach()
+            root.common.trace.enabled = False
+    finally:
+        root.common.trace.enabled = False
+        root.common.trace.file = None
+        from veles_tpu.logger import events
+        events.reset()
+    t_off = _record("obs_off", off_times)
+    t_on = _record("obs_on", on_times)
+    overhead = t_on / t_off - 1.0
+    out = {"observability_overhead_pct": round(100 * overhead, 2),
+           "observability_steps_per_sec_off": round(steps / t_off, 1),
+           "observability_steps_per_sec_on": round(steps / t_on, 1)}
+    if profiler is not None:
+        out["observability_recompiles"] = profiler.recompiles
+        if profiler.steps:
+            total = (profiler.data_wait_s + profiler.host_s +
+                     profiler.device_s)
+            out["observability_phase_split"] = {
+                "data_wait": round(profiler.data_wait_s / total, 4),
+                "host": round(profiler.host_s / total, 4),
+                "device": round(profiler.device_s / total, 4),
+            } if total else None
+    try:
+        with open(trace_file.name) as f:
+            out["observability_trace_events"] = sum(1 for _ in f)
+        os.unlink(trace_file.name)
+    except OSError:
+        pass
+    _stamp("observability stage: measured (%.2f%% overhead)"
+           % (100 * overhead))
+    return out
+
+
 def bench_liveness():
     """Stage 0 gate: one tiny jitted matmul with a real D2H flush.  If
     THIS can't finish, the tunnel is down and the orchestrator reports
@@ -622,6 +710,8 @@ def _stage_main(stage):
         out = {"precise_gemm": bench_precise_gemm()}
     elif stage == "serving":
         out = bench_serving()
+    elif stage == "observability":
+        out = bench_observability()
     else:
         raise SystemExit("unknown stage %r" % stage)
     out["spread"] = SPREAD
@@ -659,6 +749,9 @@ STAGE_PLAN = [
     # dispatch) — cheap, but still optional-tail so a tight budget
     # never trades a headline training stage for it
     ("serving", 300),
+    # tracing+metrics+profiler overhead on the MNIST step loop (must
+    # stay < 5%; ISSUE 2 acceptance) — optional tail like serving
+    ("observability", 300),
 ]
 
 

@@ -1,0 +1,222 @@
+"""Observability core units: MetricsRegistry, trace context, and the
+LatencyWindow edge cases (ISSUE 2 satellites)."""
+
+import json
+import math
+import os
+
+import pytest
+
+from veles_tpu.observability import trace
+from veles_tpu.observability.registry import (DEFAULT_BUCKETS,
+                                              MetricsRegistry)
+from veles_tpu.serving.metrics import LatencyWindow
+
+
+# -- MetricsRegistry ---------------------------------------------------------
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "a counter", ("kind",))
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(4)
+    c.labels(kind="b").inc(2)
+    assert c.labels(kind="a").value == 5
+    assert c.labels(kind="b").value == 2
+    with pytest.raises(ValueError):
+        c.labels(kind="a").inc(-1)          # counters only go up
+    g = reg.gauge("g", "a gauge")
+    g.set(7)
+    g.inc(3)
+    assert g.value == 10
+    g.labels().set_max(4)                   # watermark keeps the max
+    assert g.value == 10
+    g.labels().set_max(99)
+    assert g.value == 99
+    h = reg.histogram("h_seconds", "a histogram", buckets=(0.01, 0.1, 1))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = h.labels().snapshot()
+    assert snap["count"] == 4
+    assert abs(snap["sum"] - 5.555) < 1e-9
+
+
+def test_registry_declare_idempotent_and_conflicts():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", "x", ("l",))
+    assert reg.counter("x_total", "x", ("l",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")                # kind conflict
+    with pytest.raises(ValueError):
+        reg.counter("x_total", labels=("other",))   # label conflict
+    with pytest.raises(ValueError):
+        a.labels(wrong="v")                 # undeclared label name
+    with pytest.raises(ValueError):
+        reg.gauge("g2", labels=("l",)).inc()  # labelled needs .labels()
+
+
+def test_prometheus_rendering_shape():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests", ("model",)) \
+        .labels(model='we"ird\\name').inc(3)
+    reg.gauge("up", "liveness").set(1)
+    h = reg.histogram("lat_seconds", "latency", ("model",),
+                      buckets=(0.1, 1.0))
+    h.labels(model="m").observe(0.05)
+    h.labels(model="m").observe(0.5)
+    h.labels(model="m").observe(50)
+    text = reg.render_prometheus()
+    assert "# HELP req_total requests" in text
+    assert "# TYPE req_total counter" in text
+    assert 'req_total{model="we\\"ird\\\\name"} 3' in text
+    assert "up 1" in text
+    # cumulative buckets + +Inf + sum/count
+    assert 'lat_seconds_bucket{model="m",le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{model="m",le="1"} 2' in text
+    assert 'lat_seconds_bucket{model="m",le="+Inf"} 3' in text
+    assert 'lat_seconds_count{model="m"} 3' in text
+    assert text.endswith("\n")
+    # snapshot is strict JSON
+    snap = json.loads(json.dumps(reg.snapshot()))
+    assert snap["lat_seconds"]["type"] == "histogram"
+    assert snap["lat_seconds"]["series"][0]["count"] == 3
+    assert snap["req_total"]["series"][0]["value"] == 3
+
+
+def test_default_buckets_cover_latency_scales():
+    assert DEFAULT_BUCKETS == tuple(sorted(DEFAULT_BUCKETS))
+    assert DEFAULT_BUCKETS[0] <= 1e-3 and DEFAULT_BUCKETS[-1] >= 10
+
+
+# -- LatencyWindow edge cases (satellite) ------------------------------------
+def test_latency_window_empty_summary():
+    win = LatencyWindow()
+    s = win.summary()
+    assert s == {"n": 0, "p50_ms": None, "p95_ms": None, "p99_ms": None}
+
+
+def test_latency_window_single_sample():
+    win = LatencyWindow()
+    win.record(0.25)
+    s = win.summary()
+    assert s["n"] == 1
+    # every quantile of one sample IS that sample
+    assert s["p50_ms"] == s["p95_ms"] == s["p99_ms"] == 250.0
+    assert s["mean_ms"] == 250.0 and s["max_ms"] == 250.0
+
+
+def test_latency_window_exact_quantile_boundaries():
+    win = LatencyWindow(window=100)
+    for i in range(100):                    # 1ms .. 100ms
+        win.record((i + 1) / 1000.0)
+    s = win.summary()
+    # index semantics: idx = min(n-1, int(q*n)) over the sorted window
+    assert s["p50_ms"] == 51.0              # int(0.50*100) = 50 -> 51ms
+    assert s["p95_ms"] == 96.0              # int(0.95*100) = 95 -> 96ms
+    assert s["p99_ms"] == 100.0             # int(0.99*100) = 99 -> 100ms
+    assert s["max_ms"] == 100.0
+    assert abs(s["mean_ms"] - 50.5) < 1e-9
+    # q=1.0 clamps to the last sample instead of indexing past the end
+    assert LatencyWindow._quantile(sorted([1.0, 2.0]), 1.0) == 2.0
+
+
+def test_latency_window_wraparound_past_default_window():
+    win = LatencyWindow()                   # default window=4096
+    for i in range(5000):
+        win.record(float(i))
+    s = win.summary()
+    assert s["n"] == 4096                   # bounded, not 5000
+    # the oldest 904 samples were evicted: the window is [904, 4999]
+    assert min(win._samples) == 904.0
+    assert s["max_ms"] == 4999.0 * 1e3
+    assert s["p50_ms"] == (904 + int(0.5 * 4096)) * 1e3
+
+
+def test_latency_window_small_ring_reuse():
+    win = LatencyWindow(window=4)
+    for v in (9.0, 1.0, 2.0, 3.0, 4.0):     # 9.0 falls out
+        win.record(v)
+    s = win.summary()
+    assert s["n"] == 4 and s["max_ms"] == 4000.0
+    assert max(win._samples) == 4.0
+
+
+# -- trace context -----------------------------------------------------------
+def test_trace_context_nesting_and_payload():
+    assert trace.current() is None
+    with trace.span_context() as outer:
+        assert trace.current() is outer
+        with trace.span_context() as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            wire = trace.payload()
+            assert wire == {"trace_id": outer.trace_id,
+                            "parent_span": inner.span_id}
+        assert trace.current() is outer
+    assert trace.current() is None
+    assert trace.payload() is None
+
+
+def test_trace_adopt_wire_and_garbage():
+    with trace.adopt({"trace_id": "t1", "parent_span": "p1"}) as ctx:
+        assert ctx.trace_id == "t1" and ctx.parent_id == "p1"
+        assert trace.current() is ctx
+    # traceless / malformed peers are a no-op, never an error
+    for junk in (None, {}, {"other": 1}, "nope"):
+        with trace.adopt(junk) as ctx:
+            assert ctx is None
+
+
+def test_trace_env_round_trip():
+    env = trace.inject_env({"A": "1"})
+    assert env == {"A": "1"}                # no context -> unchanged
+    with trace.span_context() as ctx:
+        env = trace.inject_env({"A": "1"})
+        assert env[trace.TRACE_ENV] == \
+            "%s:%s" % (ctx.trace_id, ctx.span_id)
+        adopted = trace.adopt_env(env)
+        try:
+            assert adopted.trace_id == ctx.trace_id
+            assert adopted.parent_id == ctx.span_id
+        finally:
+            trace.set_ambient(None)         # clear process ambient
+    assert trace.adopt_env({}) is None
+
+
+def test_trace_ambient_is_thread_fallback():
+    import threading
+    trace.set_ambient("amb-trace")
+    try:
+        seen = {}
+
+        def worker():
+            ctx = trace.current()
+            seen["trace_id"] = ctx.trace_id if ctx else None
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert seen["trace_id"] == "amb-trace"
+        # thread-local contexts still win over the ambient fallback
+        with trace.span_context(trace_id="local") as ctx:
+            assert trace.current().trace_id == "local"
+    finally:
+        trace.set_ambient(None)
+    assert trace.current() is None
+
+
+def test_trace_dir_env_enables_event_log(tmp_path, monkeypatch):
+    """VELES_TRACE_DIR alone (no config) switches tracing on and routes
+    events to a per-pid file — the zero-plumbing worker story."""
+    from veles_tpu.logger import EventLog
+    monkeypatch.setenv("VELES_TRACE_DIR", str(tmp_path))
+    log = EventLog()
+    assert log.enabled
+    log.event("env-driven", "single")
+    log.close()
+    path = tmp_path / ("events-%d.jsonl" % os.getpid())
+    assert path.exists()
+    names = [json.loads(x)["name"] for x in open(path)]
+    assert names == ["trace_start", "env-driven"]
+    rec = [json.loads(x) for x in open(path)][0]
+    assert isinstance(rec["args"]["unix_time_s"], float)
+    assert math.isfinite(rec["args"]["unix_time_s"])

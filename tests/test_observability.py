@@ -4,6 +4,7 @@
 import contextlib
 import json
 import os
+import time
 import urllib.request
 
 import numpy
@@ -19,8 +20,8 @@ from veles_tpu.znicz.samples import mnist
 @contextlib.contextmanager
 def tracing_to(path):
     """Enable JSONL tracing to ``path`` and FULLY reset the global
-    EventLog afterwards (shared by every tracing test — one place must
-    know EventLog's reset protocol)."""
+    EventLog afterwards via its public ``reset()`` (the one place that
+    knows the reset protocol is EventLog itself)."""
     root.common.trace.enabled = True
     root.common.trace.file = str(path)
     try:
@@ -28,10 +29,7 @@ def tracing_to(path):
     finally:
         root.common.trace.enabled = False
         root.common.trace.file = None
-        events.close()
-        events._path = None
-        events._file = None
-        events.path = None
+        events.reset()
 
 
 def _make_wf(**kw):
@@ -189,3 +187,225 @@ def test_memory_report_lines():
     assert any("Peak host RSS" in ln for ln in lines), lines
     mib = float([ln for ln in lines if "RSS" in ln][0].split()[3])
     assert mib > 10, mib
+
+
+def test_event_log_reset_is_public_and_reopens(tmp_path):
+    """EventLog.reset() closes + forgets the path so the next event
+    re-resolves its destination (the old tests poked _path/_file)."""
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    with tracing_to(a):
+        events.event("first")
+    assert events.path is None          # reset() ran in the finally
+    with tracing_to(b):
+        events.event("second")
+    names_a = [json.loads(x)["name"] for x in open(a)]
+    names_b = [json.loads(x)["name"] for x in open(b)]
+    assert "first" in names_a and "second" not in names_a
+    assert "second" in names_b
+
+
+def test_event_timestamps_monotonic(tmp_path):
+    """perf_counter-based ts: in-order events never go backwards, and
+    span (X) records keep non-negative durations."""
+    path = str(tmp_path / "mono.jsonl")
+    with tracing_to(path):
+        for i in range(50):
+            events.event("tick", "single", i=i)
+        events.span("timed", 0.001)
+    records = [json.loads(x) for x in open(path)]
+    ts = [r["ts"] for r in records if r["name"] == "tick"]
+    assert ts == sorted(ts)
+    span = [r for r in records if r["name"] == "timed"][0]
+    assert span["dur"] >= 0
+
+
+def test_step_profiler_breakdown_and_registry(tmp_path):
+    """The tentpole profiler: wraps the fused step, splits data-wait /
+    host / device, counts recompiles + examples, emits train.step spans
+    AND registry series served by /metrics."""
+    from veles_tpu.observability.registry import REGISTRY
+    path = str(tmp_path / "prof.jsonl")
+    wf = _make_wf()
+    with tracing_to(path):
+        prof = wf.attach_profiler()
+        wf.run()
+    summary = prof.summary()
+    # 2 epochs x (3 train + 1 valid) minibatches of 100
+    assert summary["steps"] == 8
+    assert summary["examples"] == 800
+    assert summary["recompiles"] >= 1          # first train+eval compile
+    assert summary["host_s"] > 0
+    assert set(summary["phase_pct"]) == {"data_wait", "host", "device"}
+    assert abs(sum(summary["phase_pct"].values()) - 100) < 1.0
+    assert summary["examples_per_sec"] > 0
+    # spans carry the per-step split
+    spans = [json.loads(x) for x in open(path)]
+    steps = [r for r in spans if r["name"] == "train.step"]
+    assert len(steps) == 8
+    assert all({"data_wait_ms", "host_ms", "device_ms", "examples"}
+               <= set(r["args"]) for r in steps)
+    # registry series exist and render as Prometheus text
+    text = REGISTRY.render_prometheus()
+    assert "# TYPE veles_training_steps_total counter" in text
+    assert "veles_training_step_phase_seconds_bucket" in text
+    assert 'phase="device"' in text
+    # detach restores the unwrapped step: further runs do not count
+    prof.detach()
+    before = prof.steps
+    wf.fused_step.run()
+    assert prof.steps == before
+
+
+def test_status_server_metrics_endpoint_merges_training_and_serving():
+    """/metrics serves valid Prometheus text exposition covering
+    training AND serving series from the same registry; /status JSON
+    carries the registry snapshot under "metrics"."""
+    import re
+    from veles_tpu.serving.metrics import ServingMetrics
+    wf = _make_wf()
+    wf.attach_profiler()
+    wf.run()
+    sm = ServingMetrics("promtest")
+    sm.record_request(4, 0.002)
+    sm.record_reject()
+    sm.record_batch(8, 6, 0.001, 2)
+    server = StatusServer(0, StatusRegistry())
+    try:
+        body = urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % server.port)
+        assert body.headers.get_content_type() == "text/plain"
+        text = body.read().decode()
+        # both worlds, one registry
+        assert 'veles_training_steps_total{workflow="MnistSimple"}' \
+            in text
+        assert 'veles_serving_requests_total{model="promtest"} 1' in text
+        assert 'veles_serving_rejected_total{model="promtest"} 1' in text
+        assert 'veles_serving_request_seconds_bucket{model="promtest"' \
+            in text
+        # scrape-time derived gauges: exact quantiles + batch fill
+        assert 'veles_serving_latency_quantile_ms{model="promtest",' \
+            'quantile="p99"} 2' in text
+        assert 'veles_serving_batch_fill_ratio{model="promtest"} 0.75' \
+            in text
+        # every non-comment line must be valid exposition syntax
+        sample = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+            r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+            r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+            r' (?:[0-9.eE+-]+|\+Inf|-Inf|NaN)$')
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                assert line.startswith("# HELP ") or \
+                    line.startswith("# TYPE "), line
+            else:
+                assert sample.match(line), line
+        status = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/status" % server.port).read())
+        assert "metrics" in status
+        assert "veles_serving_requests_total" in status["metrics"]
+    finally:
+        server.stop()
+
+
+def test_jobmaster_trace_propagation_across_workers(tmp_path):
+    """ISSUE 2 acceptance: a JobMaster.map across 2 workers leaves
+    per-process JSONL traces (master + each worker) sharing ONE
+    trace_id, and tools/merge_traces.py folds them into a single
+    chrome://tracing-loadable timeline."""
+    from tools.merge_traces import merge
+    from veles_tpu.jobserver import JobMaster, WorkerPool
+    trace_dir = tmp_path / "workers"
+    trace_dir.mkdir()
+    master_file = str(tmp_path / "master.jsonl")
+    with tracing_to(master_file):
+        master = JobMaster(port=0)
+        env = {**os.environ, "VELES_TRACE_DIR": str(trace_dir)}
+        pool = WorkerPool(master.address, n=2, env=env)
+        try:
+            # barrier: both subprocess workers connected before any job
+            # is queued — otherwise a fast first worker could drain the
+            # whole map before the second finishes its python startup
+            deadline = time.monotonic() + 60
+            while master.active_workers < 2:
+                assert time.monotonic() < deadline, \
+                    "workers never connected"
+                time.sleep(0.02)
+            results = master.map(
+                [{"kind": "eval", "value": i, "sleep": 0.1}
+                 for i in range(6)], timeout=90)
+            assert [r["results"]["value"] for r in results] == \
+                list(range(6))
+            assert len({r["worker"] for r in results}) == 2, \
+                "jobs did not spread over both workers"
+        finally:
+            pool.close()
+            master.close()
+    worker_files = sorted(trace_dir.glob("events-*.jsonl"))
+    assert len(worker_files) == 2, worker_files
+    # every process agrees on the ONE trace id
+    dispatch = [json.loads(x) for x in open(master_file)
+                if "job.dispatch" in x]
+    assert len(dispatch) == 6
+    master_ids = {r["args"]["trace_id"] for r in dispatch}
+    assert master_ids == {master.trace_id}
+    for wf_path in worker_files:
+        runs = [json.loads(x) for x in open(wf_path)
+                if "job.run" in x]
+        assert runs, "worker %s emitted no job.run spans" % wf_path
+        assert {r["args"]["trace_id"] for r in runs} == \
+            {master.trace_id}
+        # the worker span is parented on the master's per-job span
+        assert all(r["args"].get("parent_span") for r in runs)
+    # worker job spans are children of the exact spans the master logged
+    master_spans = {r["args"]["span"] for r in dispatch}
+    worker_parents = set()
+    for wf_path in worker_files:
+        for x in open(wf_path):
+            if "job.run" not in x:
+                continue
+            worker_parents.add(json.loads(x)["args"]["parent_span"])
+    assert worker_parents <= master_spans and worker_parents
+    # merged timeline: one JSON object chrome://tracing can load
+    doc = merge([master_file] + [str(p) for p in worker_files],
+                trace_id=master.trace_id)
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    assert len({r["pid"] for r in doc["traceEvents"]}) == 3
+    for rec in doc["traceEvents"]:
+        assert isinstance(rec["name"], str) and rec["ph"] in "BEXiM"
+        assert isinstance(rec["ts"], (int, float))
+        assert "pid" in rec and "tid" in rec
+    # wall-clock alignment: job.run must START after its dispatch began
+    runs = sorted((r for r in doc["traceEvents"]
+                   if r["name"] == "job.run"), key=lambda r: r["ts"])
+    assert runs and runs[0]["ts"] >= 0
+
+
+def test_serving_request_batch_trace_links(tmp_path):
+    """Serving causality: the HTTP request span's id reappears in the
+    batch span's links, and the response echoes X-Trace-Id."""
+    from veles_tpu.serving import InferenceServer
+    path = str(tmp_path / "serve.jsonl")
+    with tracing_to(path):
+        server = InferenceServer(
+            {"echo": lambda x: x * 2.0},
+            max_batch=8, sample_shape=(3,))
+        try:
+            req = urllib.request.Request(
+                server.url + "/api/echo",
+                json.dumps({"input": [[1.0, 2.0, 3.0]]}).encode(),
+                {"Content-Type": "application/json",
+                 "X-Trace-Id": "feedfacecafef00d"})
+            resp = urllib.request.urlopen(req)
+            assert resp.headers["X-Trace-Id"] == "feedfacecafef00d"
+            assert json.loads(resp.read())["output"] == \
+                [[2.0, 4.0, 6.0]]
+        finally:
+            server.stop()
+    records = [json.loads(x) for x in open(path)]
+    reqs = [r for r in records if r["name"] == "serving.request"]
+    batches = [r for r in records if r["name"] == "serving.batch"]
+    assert reqs and batches
+    assert reqs[0]["args"]["trace_id"] == "feedfacecafef00d"
+    assert reqs[0]["args"]["status"] == 200
+    links = [s for b in batches for s in b["args"].get("links", ())]
+    assert reqs[0]["args"]["span"] in links

@@ -1,0 +1,120 @@
+"""Merge per-process ``events-*.jsonl`` streams into ONE Chrome trace.
+
+Every veles_tpu process traces to its own JSONL file (logger.EventLog);
+a distributed run — a JobMaster plus N workers plus their trial
+subprocesses — therefore leaves a pile of files that share one
+``trace_id`` (observability/trace.py) but live on per-process
+``perf_counter`` clocks.  This tool:
+
+- parses every line of every input file (skipping foreign/corrupt
+  lines rather than failing the merge);
+- aligns the per-process clocks onto one absolute timeline using the
+  ``trace_start`` wall-clock anchor record each file begins with (files
+  without an anchor keep their relative timestamps);
+- optionally filters to one ``--trace-id``;
+- writes a single ``{"traceEvents": [...]}`` JSON object that loads
+  directly in chrome://tracing or https://ui.perfetto.dev.
+
+Usage::
+
+    python tools/merge_traces.py -o merged.json /tmp/run/events-*.jsonl
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def read_events(path):
+    """Parse one JSONL stream; returns (events, anchor_unix_s_or_None).
+
+    The anchor pairs a file-relative ``ts`` with an absolute wall-clock
+    time, letting the merge shift this process onto the shared
+    timeline."""
+    events, anchor = [], None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or \
+                    not isinstance(rec.get("ts"), (int, float)):
+                continue
+            if rec.get("name") == "trace_start":
+                t = (rec.get("args") or {}).get("unix_time_s")
+                if isinstance(t, (int, float)):
+                    anchor = (float(rec["ts"]), float(t))
+            events.append(rec)
+    return events, anchor
+
+
+def merge(paths, trace_id=None):
+    """Merge JSONL files → a chrome://tracing-loadable dict."""
+    streams = []
+    anchored_starts = []
+    for path in paths:
+        events, anchor = read_events(path)
+        streams.append((events, anchor))
+        if anchor is not None:
+            anchored_starts.append(anchor[1] - anchor[0] / 1e6)
+    # absolute time of the earliest anchored process start becomes t=0
+    origin = min(anchored_starts) if anchored_starts else None
+    merged = []
+    for events, anchor in streams:
+        if anchor is not None and origin is not None:
+            ts0, unix0 = anchor
+            offset = (unix0 - ts0 / 1e6 - origin) * 1e6
+        else:
+            offset = 0.0
+        for rec in events:
+            if trace_id is not None:
+                args = rec.get("args") or {}
+                if args.get("trace_id") != trace_id and \
+                        rec.get("name") != "trace_start":
+                    continue
+            rec = dict(rec)
+            rec["ts"] = round(rec["ts"] + offset, 1)
+            merged.append(rec)
+    merged.sort(key=lambda r: r.get("ts", 0))
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python tools/merge_traces.py",
+        description="Merge per-process events-*.jsonl into one "
+                    "chrome://tracing / Perfetto JSON file.")
+    p.add_argument("inputs", nargs="+",
+                   help="JSONL files, globs, or directories "
+                        "(directories expand to their events-*.jsonl)")
+    p.add_argument("-o", "--output", default="merged-trace.json")
+    p.add_argument("--trace-id", default=None,
+                   help="keep only records of this trace_id")
+    args = p.parse_args(argv)
+    paths = []
+    for item in args.inputs:
+        if os.path.isdir(item):
+            paths.extend(sorted(glob.glob(
+                os.path.join(item, "events-*.jsonl"))))
+        else:
+            expanded = sorted(glob.glob(item))
+            paths.extend(expanded or [item])
+    if not paths:
+        print("merge_traces: no input files", file=sys.stderr)
+        return 1
+    doc = merge(paths, trace_id=args.trace_id)
+    with open(args.output, "w") as f:
+        json.dump(doc, f)
+    print("merge_traces: %d events from %d file(s) -> %s"
+          % (len(doc["traceEvents"]), len(paths), args.output))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -174,6 +174,11 @@ def make_parser():
                    help="write the unit graph in dot format")
     p.add_argument("--stats", action="store_true",
                    help="print per-unit timing stats after the run")
+    p.add_argument("--profile", action="store_true",
+                   help="attach the StepProfiler (data-wait/host/device "
+                        "step split, recompile count, examples/sec into "
+                        "/metrics and the result JSON; equivalent to "
+                        "root.common.observability.profile=True)")
     p.add_argument("--no-fix-config", action="store_true",
                    help="keep Range placeholders (genetic optimizer use)")
     from .cmdline import contribute_arguments
@@ -309,6 +314,8 @@ class Main:
         args = self.args
         if args.dry_run == "load":
             return self.workflow
+        if args.profile:
+            root.common.observability.profile = True
         self.launcher.initialize(**kwargs)
         if args.visualize:
             self.workflow.generate_graph(args.visualize)

@@ -29,6 +29,7 @@ import subprocess
 import sys
 import time
 
+from .observability import trace as _trace
 from .units import Unit
 
 
@@ -104,12 +105,15 @@ class ElasticRunner:
         """Returns the final returncode (0 = the run completed)."""
         delay = self.backoff
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # every (re)launch joins the supervisor's trace: crash-restart
+        # chains then read as one causal timeline in the merged trace
+        env = _trace.inject_env(self.env)
         while True:
             argv = [self.python, "-m", "veles_tpu", self.model] + self.argv
             snapshot = latest_snapshot(self.snapshot_dir, self.prefix)
             if snapshot:
                 argv += ["--snapshot", snapshot]
-            proc = subprocess.run(argv, cwd=repo, env=self.env,
+            proc = subprocess.run(argv, cwd=repo, env=env,
                                   capture_output=self.silent)
             self.history.append({"rc": proc.returncode,
                                  "resumed_from": snapshot})

@@ -39,13 +39,20 @@ import sys
 import threading
 import time
 
+from .observability import trace as _trace
+
 _SENTINEL_TIMEOUT = 0.1
 
 
 class Job:
-    """One unit of work; ``result`` is set exactly once ``done`` fires."""
+    """One unit of work; ``result`` is set exactly once ``done`` fires.
 
-    __slots__ = ("id", "payload", "attempts", "done", "result", "worker")
+    ``span`` is the job's span id in the master's trace: the worker
+    adopts it as parent, so the merged per-process event files show
+    dispatch (master) and execution (worker) causally linked."""
+
+    __slots__ = ("id", "payload", "attempts", "done", "result", "worker",
+                 "span")
 
     def __init__(self, job_id, payload):
         self.id = job_id
@@ -54,6 +61,7 @@ class Job:
         self.done = threading.Event()
         self.result = None
         self.worker = None
+        self.span = _trace.new_id()
 
 
 def _send(wfile, msg):
@@ -95,6 +103,11 @@ class JobMaster:
                   "Set VELES_JOB_SECRET on master and workers."
                   % host, file=sys.stderr)
         self.active_workers = 0
+        # one trace for everything this master farms out: joins an
+        # already-active context (e.g. a traced ensemble run) or starts
+        # a fresh trace; carried to workers on every job message
+        ctx = _trace.current()
+        self.trace_id = ctx.trace_id if ctx else _trace.new_id()
         self._sock = socket.create_server((host, port))
         self.address = self._sock.getsockname()[:2]
         self._pending = queue.Queue()
@@ -215,8 +228,11 @@ class JobMaster:
                 current = job
                 job.attempts += 1
                 job.worker = name
+                t_dispatch = time.perf_counter()
                 _send(wfile, {"op": "job", "id": job.id,
-                              "payload": job.payload})
+                              "payload": job.payload,
+                              "trace": {"trace_id": self.trace_id,
+                                        "parent_span": job.span}})
                 msg = _recv(rfile)
                 if msg is None:
                     raise ConnectionError("worker %s died mid-job" % name)
@@ -231,6 +247,14 @@ class JobMaster:
                                    "error": msg.get("error"),
                                    "worker": name,
                                    "attempts": job.attempts})
+                # master-side view of the same job span the worker ran
+                # under — merged traces link the two via span ids
+                from .logger import events
+                events.span("job.dispatch",
+                            time.perf_counter() - t_dispatch,
+                            job=job.id, worker=name,
+                            attempts=job.attempts,
+                            trace_id=self.trace_id, span=job.span)
                 current = None
             try:
                 _send(wfile, {"op": "bye"})
@@ -325,7 +349,19 @@ def worker_loop(host, port, name=None, python=None, secret=None):
                 return
             if msg.get("op") != "job":
                 continue
-            result = execute_payload(msg["payload"], python=python)
+            # run under the master's trace context: this worker's
+            # events (and any trial subprocess it spawns — run_trial
+            # injects the context into the child env) share the
+            # master's trace_id, parented on the job's span
+            with _trace.adopt(msg.get("trace")):
+                t0 = time.perf_counter()
+                result = execute_payload(msg["payload"], python=python)
+                from .logger import events
+                events.span("job.run", time.perf_counter() - t0,
+                            job=msg["id"], worker=name,
+                            payload_kind=msg["payload"].get("kind",
+                                                            "trial"),
+                            rc=result.get("rc"))
             result.update({"op": "result", "id": msg["id"]})
             _send(wfile, result)
     finally:

@@ -25,6 +25,7 @@ import sys
 import time
 
 from .config import root
+from .observability import trace as _trace
 
 
 def memory_report(device=None):
@@ -67,6 +68,11 @@ class Launcher:
         self.stealth = stealth          # no external reporting side-cars
         self.workflow = None
         self.device = None
+        self.profiler = None
+        # a parent process (jobserver worker, ElasticRunner, GA trial
+        # farm) may have handed us its trace context — join it so this
+        # run's events share the distributed trace_id
+        _trace.adopt_env()
         self.start_time = None
         self.finish_time = None
         self.on_initialized = []        # callbacks(workflow)
@@ -95,16 +101,29 @@ class Launcher:
         if self.device is None:
             self.device = Device(backend=self.backend)
         self.workflow.initialize(device=self.device, **kwargs)
+        if root.common.observability.get("profile", False) and \
+                not self.stealth:
+            # opt-in step profiler side-car (fencing is honest but not
+            # free — see observability/profiler.py): CLI flag or
+            # root.common.observability.profile = True
+            try:
+                self.profiler = self.workflow.attach_profiler()
+            except ValueError:
+                self.profiler = None    # no training step (e.g. eval wf)
         for cb in self.on_initialized:
             cb(self.workflow)
         return self
 
     def run(self):
         self.start_time = time.time()
-        try:
-            self.workflow.run()
-        finally:
-            self.finish_time = time.time()
+        # one span context per run: every event the run emits (unit
+        # spans, train.step, serving) then shares a trace_id — fresh
+        # unless a parent process's context was adopted at construction
+        with _trace.span_context():
+            try:
+                self.workflow.run()
+            finally:
+                self.finish_time = time.time()
         for cb in self.on_finished:
             cb(self.workflow)
         if self.result_file:
@@ -126,6 +145,8 @@ class Launcher:
             results["seconds"] = round(
                 (self.finish_time or time.time()) - self.start_time, 3)
         results["backend"] = getattr(self.device, "backend", self.backend)
+        if self.profiler is not None:
+            results["profile"] = self.profiler.summary()
         return results
 
     def write_results(self, file):

@@ -25,6 +25,7 @@ import threading
 import time
 
 from .config import root
+from .observability import trace as _trace
 
 _COLORS = {"DEBUG": "\033[37m", "INFO": "\033[32m", "WARNING": "\033[33m",
            "ERROR": "\033[31m", "CRITICAL": "\033[41m"}
@@ -96,30 +97,50 @@ class EventLog:
         self._path = path
         self._file = None
         self._lock = threading.Lock()
-        self._t0 = time.time()
+        self.path = None
+        # perf_counter, not time.time(): a wall-clock jump (NTP step,
+        # suspend/resume) must never produce out-of-order or
+        # negative-duration trace events
+        self._t0 = time.perf_counter()
 
     @property
     def enabled(self):
-        return bool(root.common.trace.get("enabled", False))
+        # VELES_TRACE_DIR enables tracing in ANY veles_tpu process —
+        # the zero-plumbing switch that makes spawned workers trace
+        # (jobserver.WorkerPool children inherit the environment)
+        return bool(root.common.trace.get("enabled", False) or
+                    os.environ.get("VELES_TRACE_DIR"))
 
     def _ensure_open(self):
         if self._file is not None:
             return
+        trace_dir = os.environ.get("VELES_TRACE_DIR")
         path = (self._path or root.common.trace.get("file") or
+                (os.path.join(trace_dir, "events-%d.jsonl" % os.getpid())
+                 if trace_dir else None) or
                 os.path.join(root.common.dirs.get("events", "."),
                              "events-%d.jsonl" % os.getpid()))
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._file = open(path, "a", buffering=1)  # line buffered
         self.path = path
+        # wall-clock anchor: ts values are per-process perf_counter
+        # deltas; this record lets tools/merge_traces.py align several
+        # processes' files onto one absolute timeline
+        self._file.write(json.dumps({
+            "name": "trace_start", "ph": "i",
+            "ts": round((time.perf_counter() - self._t0) * 1e6, 1),
+            "pid": os.getpid(), "tid": threading.get_ident(),
+            "args": {"unix_time_s": time.time()}}) + "\n")
         atexit.register(self.close)
 
     def event(self, name, kind="single", duration=None, **info):
         """Record one event; no-op unless tracing is enabled."""
         if not self.enabled:
             return
+        ctx = _trace.current()
         with self._lock:
             self._ensure_open()
-            ts = time.time() - self._t0
+            ts = time.perf_counter() - self._t0
             if duration is not None:
                 ts -= duration  # trace-viewer X events anchor at start
             record = {"name": name, "ph": self._PH.get(kind, "i"),
@@ -127,6 +148,14 @@ class EventLog:
                       "pid": os.getpid(), "tid": threading.get_ident()}
             if duration is not None:
                 record["dur"] = round(duration * 1e6, 1)
+            if ctx is not None:
+                # causal links ride in args (trace viewers show them;
+                # explicit trace_id/span kwargs win via setdefault)
+                info = dict(info) if info else {}
+                info.setdefault("trace_id", ctx.trace_id)
+                info.setdefault("span", ctx.span_id)
+                if ctx.parent_id:
+                    info.setdefault("parent_span", ctx.parent_id)
             if info:
                 record["args"] = info
             self._file.write(json.dumps(record) + "\n")
@@ -140,6 +169,17 @@ class EventLog:
             if self._file is not None:
                 self._file.close()
                 self._file = None
+
+    def reset(self):
+        """Close the output and forget every path decision so the next
+        event re-resolves its destination from config/env — THE way for
+        tests (and forked workers) to return the process-global log to
+        its pristine state instead of poking ``_path``/``_file``."""
+        self.close()
+        with self._lock:
+            self._path = None
+            self.path = None
+            self._t0 = time.perf_counter()
 
 
 #: process-global event log (reference: per-node Mongo duplication)

@@ -51,6 +51,8 @@ class DistributedTrainStep(FusedTrainStep):
             m, self._params_, self._opt_, self.model_axis, self.tp_mode)
         batch_shard = mesh_mod.batch_sharding(m, self.data_axis)
         label_shard = batch_shard
+        mesh_mod.register_mesh_metrics(
+            m, getattr(self._workflow, "name", "-"))
 
         self._params_ = jax.device_put(self._params_, param_shard)
         self._opt_ = jax.device_put(self._opt_, opt_shard)

@@ -40,6 +40,26 @@ def make_mesh(axes=None, devices=None):
     return Mesh(dev_array, tuple(names))
 
 
+def register_mesh_metrics(mesh, workflow="-"):
+    """Publish the mesh topology into the observability registry (one
+    gauge series per axis) and stamp a ``mesh.initialized`` instant into
+    the event log — a scrape of ``/metrics`` then says exactly what
+    geometry a distributed step is running on."""
+    from ..logger import events
+    from ..observability.registry import REGISTRY
+    g = REGISTRY.gauge("veles_mesh_axis_devices",
+                       "Device-mesh axis sizes of the sharded step",
+                       ("workflow", "axis"))
+    for axis, size in mesh.shape.items():
+        g.labels(workflow=workflow, axis=axis).set(int(size))
+    REGISTRY.gauge("veles_mesh_devices_total",
+                   "Total devices in the sharded step's mesh",
+                   ("workflow",)).labels(workflow=workflow) \
+        .set(int(numpy.prod(list(mesh.shape.values()))))
+    events.event("mesh.initialized", workflow=workflow,
+                 axes=dict(mesh.shape))
+
+
 def batch_sharding(mesh, data_axis="data"):
     """Sharding for a [batch, ...] array: split the leading dim."""
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -60,6 +60,8 @@ class DistributedScanStep(ScanEpochStep):
             self.tp_mode)
         self._param_shard_, self._opt_shard_, self._rep_ = \
             param_shard, opt_shard, rep
+        mesh_mod.register_mesh_metrics(
+            self.mesh, getattr(self._workflow, "name", "-"))
         self._params_ = jax.device_put(self._params_, param_shard)
         self._opt_ = jax.device_put(self._opt_, opt_shard)
         self._macc_ = jax.device_put(self._macc_, rep)

@@ -1,15 +1,21 @@
-"""Serving observability: per-model latency histograms and throughput.
+"""Serving observability: registry-backed counters + latency quantiles.
 
 The training side already streams Chrome-trace events through
 ``logger.EventLog`` (logger.py:86); the serving side plugs into the same
 channel — every executed batch becomes a ``serving.batch`` span, every
 shed request a ``serving.reject`` instant — so one Perfetto timeline
-shows minibatches and inference batches side by side.  On top of that,
-:class:`ServingMetrics` keeps the aggregate numbers a load balancer or
-dashboard polls from ``GET /metrics``: request/row counts, p50/p95/p99
-latency over a sliding window, queue depth, batch-fill ratio (real rows
-vs padded rows — the price of power-of-two bucketing), and req/s both
-lifetime and over the recent window.
+shows minibatches and inference batches side by side.
+
+Counter state lives in the process-global
+:class:`~veles_tpu.observability.registry.MetricsRegistry` (labelled by
+model) instead of private attributes: the SAME numbers the serving
+server's JSON ``/metrics`` reports are what Prometheus scrapes from the
+status server's ``/metrics`` text endpoint, next to the training
+profiler's series.  :class:`ServingMetrics` keeps only what the registry
+cannot express — the exact-quantile latency window and the recent-rps
+completion ring — plus per-instance baselines so ``snapshot()`` stays
+scoped to one scheduler's lifetime even when several same-named models
+have existed in the process.
 """
 
 import collections
@@ -17,6 +23,7 @@ import threading
 import time
 
 from ..logger import events
+from ..observability.registry import REGISTRY
 
 
 class LatencyWindow:
@@ -57,6 +64,25 @@ class LatencyWindow:
                 "max_ms": to_ms(ordered[-1])}
 
 
+#: registry counter families shared by every ServingMetrics instance
+_COUNTERS = {
+    "requests": ("veles_serving_requests_total",
+                 "Completed inference requests"),
+    "rows": ("veles_serving_rows_total",
+             "Sample rows served"),
+    "failures": ("veles_serving_failures_total",
+                 "Requests answered with an internal error"),
+    "rejected": ("veles_serving_rejected_total",
+                 "Requests shed by backpressure (HTTP 429)"),
+    "batches": ("veles_serving_batches_total",
+                "Executed dispatch batches"),
+    "batch_rows": ("veles_serving_batch_rows_total",
+                   "Real rows across executed batches"),
+    "padded_rows": ("veles_serving_padded_rows_total",
+                    "Padding rows added by power-of-two bucketing"),
+}
+
+
 class ServingMetrics:
     """Aggregate serving counters for one model.
 
@@ -73,55 +99,95 @@ class ServingMetrics:
 
     RATE_WINDOW = 2048  # completion timestamps kept for the recent-rps view
 
-    def __init__(self, model="default"):
+    def __init__(self, model="default", registry=None):
         self.model = model
+        self.registry = registry or REGISTRY
         self.latency = LatencyWindow()
         self._lock = threading.Lock()
         self._t0 = time.time()
-        self.requests = 0
-        self.rows = 0
-        self.failures = 0
-        self.rejected = 0
-        self.batches = 0
-        self.batch_rows = 0
-        self.padded_rows = 0
+        self._c = {key: self.registry.counter(name, help, ("model",))
+                   .labels(model=model)
+                   for key, (name, help) in _COUNTERS.items()}
+        # baseline at construction: the registry series are process-
+        # global and monotonic (Prometheus semantics); snapshot() is
+        # per-instance, so it reads deltas from here
+        self._base = {key: child.value for key, child in self._c.items()}
+        self._h_latency = self.registry.histogram(
+            "veles_serving_request_seconds",
+            "End-to-end request latency", ("model",)).labels(model=model)
+        # scrape-time gauges derived from the exact-quantile window and
+        # the fill counters (refreshed via collect_metrics just before
+        # every /metrics render — Prometheus quantile gauges would be
+        # stale or request-path-expensive otherwise)
+        self._g_quantile = self.registry.gauge(
+            "veles_serving_latency_quantile_ms",
+            "Exact latency quantiles over the recent sample window",
+            ("model", "quantile"))
+        self._g_fill = self.registry.gauge(
+            "veles_serving_batch_fill_ratio",
+            "Real rows / (real + padding) across executed batches",
+            ("model",)).labels(model=model)
+        self.registry.register_collector(self)
         self._completions = collections.deque(maxlen=self.RATE_WINDOW)
+
+    def _count(self, key):
+        return int(round(self._c[key].value - self._base[key]))
+
+    def __getattr__(self, name):
+        # the seed exposed counters as plain attributes; keep that
+        # surface (metrics.requests et al.) over the registry state
+        if name in _COUNTERS:
+            return self._count(name)
+        raise AttributeError(name)
 
     # -- request-side --------------------------------------------------------
     def record_request(self, rows, seconds, ok=True):
         self.latency.record(seconds)
+        self._h_latency.observe(seconds)
+        self._c["requests"].inc()
+        self._c["rows"].inc(int(rows))
+        if not ok:
+            self._c["failures"].inc()
         with self._lock:
-            self.requests += 1
-            self.rows += int(rows)
-            if not ok:
-                self.failures += 1
             self._completions.append(time.time())
 
     def record_reject(self):
-        with self._lock:
-            self.rejected += 1
+        self._c["rejected"].inc()
         events.event("serving.reject", model=self.model)
 
     # -- dispatch-side -------------------------------------------------------
-    def record_batch(self, bucket, rows, seconds, n_requests):
-        with self._lock:
-            self.batches += 1
-            self.batch_rows += int(rows)
-            self.padded_rows += int(bucket) - int(rows)
+    def record_batch(self, bucket, rows, seconds, n_requests, links=None):
+        """``links``: request span ids batched into this dispatch — the
+        causal glue between per-request and per-batch spans in the
+        merged trace."""
+        self._c["batches"].inc()
+        self._c["batch_rows"].inc(int(rows))
+        self._c["padded_rows"].inc(int(bucket) - int(rows))
+        extra = {"links": links} if links else {}
         events.span("serving.batch", seconds, model=self.model,
                     bucket=int(bucket), rows=int(rows),
-                    requests=int(n_requests))
+                    requests=int(n_requests), **extra)
+
+    def collect_metrics(self):
+        """Refresh the derived gauges (called by the registry at scrape
+        time, holding only a weak reference to this object)."""
+        s = self.latency.summary()
+        for q in ("p50", "p95", "p99"):
+            value = s.get("%s_ms" % q)
+            if value is not None:
+                self._g_quantile.labels(model=self.model,
+                                        quantile=q).set(value)
+        filled = self._c["batch_rows"].value
+        padded = self._c["padded_rows"].value
+        if filled + padded:
+            self._g_fill.set(filled / (filled + padded))
 
     # -- reader --------------------------------------------------------------
     def snapshot(self):
         now = time.time()
         with self._lock:
             completions = list(self._completions)
-            counters = {"requests": self.requests, "rows": self.rows,
-                        "failures": self.failures, "rejected": self.rejected,
-                        "batches": self.batches,
-                        "batch_rows": self.batch_rows,
-                        "padded_rows": self.padded_rows}
+        counters = {key: self._count(key) for key in _COUNTERS}
         uptime = max(now - self._t0, 1e-9)
         recent_rps = None
         if len(completions) >= 2:

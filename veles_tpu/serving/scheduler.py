@@ -33,6 +33,7 @@ from concurrent.futures import Future
 import numpy
 
 from ..logger import events
+from ..observability import trace as _trace
 from .metrics import ServingMetrics
 
 
@@ -135,13 +136,17 @@ def adapt_model(model, sample_shape=None):
 
 
 class _Pending:
-    __slots__ = ("x", "n", "future", "enqueued")
+    __slots__ = ("x", "n", "future", "enqueued", "trace")
 
     def __init__(self, x):
         self.x = x
         self.n = int(x.shape[0])
         self.future = Future()
         self.enqueued = time.perf_counter()
+        # the submitting thread's trace context (the HTTP handler's
+        # request span): the dispatch worker links the batch span back
+        # to every request it served
+        self.trace = _trace.current()
 
 
 _STOP = object()
@@ -357,8 +362,13 @@ class BucketScheduler:
                 r.future.set_result(out[off:off + r.n])
             off += r.n
         self._release(len(batch))
+        # request span ids riding this batch (bounded: a full 64-batch
+        # of tiny requests must not bloat every span record)
+        links = [r.trace.span_id for r in batch
+                 if r.trace is not None][:16] or None
         self.metrics.record_batch(bucket, rows,
-                                  time.perf_counter() - t0, len(batch))
+                                  time.perf_counter() - t0, len(batch),
+                                  links=links)
 
     def _release(self, n):
         with self._depth_lock:

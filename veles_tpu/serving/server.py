@@ -33,6 +33,8 @@ import uuid
 from http.server import ThreadingHTTPServer
 
 from ..httpjson import ClientError, JsonRequestHandler
+from ..logger import events
+from ..observability import trace as _trace
 from .registry import ModelRegistry
 from .scheduler import SchedulerClosed, SchedulerOverflow
 
@@ -72,8 +74,22 @@ class _ServingHandler(JsonRequestHandler):
 
     # -- the inference path --------------------------------------------------
     def _infer(self, name):
+        # request → batch → executable causality: the request runs in a
+        # span context (trace id from the client's X-Trace-Id header, or
+        # a fresh one), the scheduler captures it at submit, and the
+        # batch span links back to these request spans
+        with _trace.span_context(
+                trace_id=self.headers.get("X-Trace-Id") or None) as ctx:
+            t0 = time.perf_counter()
+            status = self._infer_traced(name, ctx)
+            events.span("serving.request", time.perf_counter() - t0,
+                        model=name or "<default>", status=status)
+
+    def _infer_traced(self, name, ctx):
+        """The request body; returns the HTTP status it answered."""
         srv = self.server_ref
         entry = srv.registry.resolve(name)
+        trace_hdr = {"X-Trace-Id": ctx.trace_id}
         try:
             batch = self.read_input_payload()
             if batch.ndim == 1:
@@ -81,26 +97,26 @@ class _ServingHandler(JsonRequestHandler):
             if entry is None:
                 self.send_json(404, {
                     "error": "unknown model %r" % (name or "<default>"),
-                    "models": srv.registry.names()})
-                return
+                    "models": srv.registry.names()}, headers=trace_hdr)
+                return 404
             entry.scheduler.validate(batch)
         except ClientError as e:
-            self.send_json(400, {"error": str(e)})
-            return
+            self.send_json(400, {"error": str(e)}, headers=trace_hdr)
+            return 400
         except ValueError as e:             # shape mismatch et al.
-            self.send_json(400, {"error": str(e)})
-            return
+            self.send_json(400, {"error": str(e)}, headers=trace_hdr)
+            return 400
         try:
             result, out = entry.infer(batch, timeout=srv.request_timeout)
         except SchedulerOverflow as e:
             self.send_json(429, {"error": "server overloaded: %s" % e,
                                  "model": entry.name},
-                           headers={"Retry-After": "1"})
-            return
+                           headers={"Retry-After": "1", **trace_hdr})
+            return 429
         except SchedulerClosed:
             self.send_json(503, {"error": "server is draining"},
-                           headers={"Connection": "close"})
-            return
+                           headers={"Connection": "close", **trace_hdr})
+            return 503
         except Exception:
             # server fault: log the traceback HERE, answer a generic
             # body — internals must not leak to the client
@@ -108,9 +124,12 @@ class _ServingHandler(JsonRequestHandler):
             log.exception("inference failed on model %r (error id %s)",
                           entry.name, error_id)
             self.send_json(500, {"error": "internal inference error",
-                                 "model": entry.name, "id": error_id})
-            return
-        self.send_json(200, {"result": result, "output": out.tolist()})
+                                 "model": entry.name, "id": error_id},
+                           headers=trace_hdr)
+            return 500
+        self.send_json(200, {"result": result, "output": out.tolist()},
+                       headers=trace_hdr)
+        return 200
 
 
 class InferenceServer:

@@ -12,6 +12,8 @@ import subprocess
 import sys
 import tempfile
 
+from .observability import trace as _trace
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -19,8 +21,13 @@ def run_trial(model, argv, timeout=None, env=None, python=None):
     """Run one CLI trial; returns (rc, results_dict_or_None, error_text).
 
     ``rc`` is the subprocess exit code (-1 for timeout); ``results`` is
-    the parsed ``--result-file`` JSON when the trial succeeded."""
+    the parsed ``--result-file`` JSON when the trial succeeded.  When a
+    trace context is active (a traced GA/ensemble run, or a jobserver
+    worker executing a traced master's job) it is handed to the child
+    via the environment, so the trial's own event file joins the same
+    distributed trace."""
     python = python or sys.executable
+    env = _trace.inject_env(env)
     fd, result_file = tempfile.mkstemp(prefix="veles-tpu-trial-",
                                        suffix=".json")
     os.close(fd)

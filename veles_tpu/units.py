@@ -238,11 +238,22 @@ class Unit(Lockable, IDistributable, metaclass=UnitRegistry):
             name = self.__class__.__name__
             if name in root.common.get("timings", set()):
                 print("%s: run %.3f ms" % (self.name, dt * 1e3))
-            if root.common.trace.get("enabled", False):
+            if root.common.observability.get("unit_metrics", False):
+                # opt-in: every unit run lands in the process-global
+                # registry (one histogram series per unit name) — the
+                # /metrics twin of print_stats' end-of-run table
+                from .observability.registry import REGISTRY
+                REGISTRY.histogram(
+                    "veles_unit_run_seconds",
+                    "Per-unit run() wall time",
+                    ("unit", "cls")).labels(
+                    unit=self.name, cls=name).observe(dt)
+            from .logger import events
+            if events.enabled:
                 # per-run span into the JSONL event stream (the Mongo
                 # event replacement — reference logger.py:264-289 wrapped
-                # run the same way)
-                from .logger import events
+                # run the same way); events.enabled also honors the
+                # VELES_TRACE_DIR env switch, not just the config flag
                 events.span(self.name, dt, cls=name)
         if self.stopped and not isinstance(self, Container):
             return  # unit declared itself done; FireStarter can revive it

@@ -95,7 +95,20 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         route = urllib.parse.urlparse(self.path).path
         if route == "/status":
-            self._send(200, json.dumps(self.registry.snapshot(), indent=2))
+            # workflow heartbeats merged with the process-global metrics
+            # registry under the reserved "metrics" key — one JSON view
+            # of everything this process measures
+            from .observability.registry import REGISTRY
+            payload = dict(self.registry.snapshot())
+            payload["metrics"] = REGISTRY.snapshot()
+            self._send(200, json.dumps(payload, indent=2))
+        elif route == "/metrics":
+            # Prometheus text exposition 0.0.4: training (step profiler,
+            # unit timings) and serving (request/batch counters,
+            # latency histograms) from the SAME registry
+            from .observability.registry import REGISTRY
+            self._send(200, REGISTRY.render_prometheus(),
+                       "text/plain; version=0.0.4; charset=utf-8")
         elif route == "/history":
             self._send(200, json.dumps(self.registry.history(), indent=2))
         elif route == "/plots" or route.startswith("/plots/"):
@@ -353,7 +366,9 @@ class _Handler(BaseHTTPRequestHandler):
             "<a href=\"/forge\">forge</a> · "
             "<a href=\"/bboxer\">bboxer</a> · "
             "<a href=\"/status\">status JSON</a> · "
-            "<a href=\"/history\">history JSON</a></p></body></html>"
+            "<a href=\"/history\">history JSON</a> · "
+            "<a href=\"/metrics\">metrics (prometheus)</a></p>"
+            "</body></html>"
             % ("".join(sections) or "<p>no workflows reporting</p>"))
 
     def _serve_plots(self, route):

@@ -263,6 +263,19 @@ class Workflow(Container):
         self.run()
         callback(self.generate_data_for_master())
 
+    # -- observability -------------------------------------------------------
+    def attach_profiler(self, **kwargs):
+        """Instrument this workflow's training step with a
+        :class:`~veles_tpu.observability.profiler.StepProfiler`
+        (data-wait/host/device split, recompile count, examples/sec,
+        memory watermarks → registry metrics + EventLog spans).  Call
+        after ``initialize`` — the step's jitted functions must exist
+        for recompile accounting.  The profiler is also reachable as
+        ``self.profiler``; ``profiler.detach()`` removes it."""
+        from .observability.profiler import StepProfiler
+        self.profiler = StepProfiler(self, **kwargs)
+        return self.profiler
+
     # -- results / stats -----------------------------------------------------
     def gather_results(self):
         """Collect metrics from every IResultProvider unit
