@@ -8,7 +8,12 @@ the **epoch-scan path** (``znicz/scan_step.py``): every dispatch carries
 ``steps_per_dispatch`` fused train steps inside one ``lax.scan``, so the
 number reflects chip compute, not the ~14 ms per-launch RTT of the
 tunneled (axon) transport.  The per-launch path is reported alongside as
-``alexnet_step_images_per_sec`` so dispatch overhead stays visible.
+``alexnet_step_images_per_sec`` so dispatch overhead stays visible — as of
+ISSUE 3 that number runs with the async prefetching input pipeline ON
+(``loader/prefetch.py``), with ``alexnet_step_sync_images_per_sec``,
+``alexnet_step_prefetch_speedup`` and the fenced profiler's
+``alexnet_step[_sync]_data_wait_pct`` recording the prefetch-off
+comparison in the same run.
 
 ``vs_baseline`` compares against the reference's CUDA backend era:
 published Caffe/cuDNN-v1 AlexNet training throughput on the GTX TITAN /
@@ -194,7 +199,7 @@ def _xla_flops_per_step(step, wf, batch):
 
 
 def _make_alexnet(batch, compute_dtype=None, epoch_scan=False,
-                  use_pallas_lrn=False):
+                  use_pallas_lrn=False, prefetch_depth=None):
     from veles_tpu.backends import Device
     from veles_tpu.config import root
     from veles_tpu.prng import RandomGenerator
@@ -208,9 +213,12 @@ def _make_alexnet(batch, compute_dtype=None, epoch_scan=False,
         root.common.engine.use_pallas = True
     try:
         trainer = {"compute_dtype": compute_dtype} if compute_dtype else {}
+        loader_cfg = {"minibatch_size": batch, "n_train": 8 * batch,
+                      "n_valid": batch, "prng": RandomGenerator().seed(3)}
+        if prefetch_depth is not None:
+            loader_cfg["prefetch_depth"] = prefetch_depth
         wf = alexnet.create_workflow(
-            loader={"minibatch_size": batch, "n_train": 8 * batch,
-                    "n_valid": batch, "prng": RandomGenerator().seed(3)},
+            loader=loader_cfg,
             decision={"max_epochs": 10 ** 9, "silent": True},
             trainer=trainer, epoch_scan=epoch_scan)
         wf.initialize(device=Device(backend="auto"))
@@ -256,35 +264,73 @@ def bench_alexnet_scan(batch=128, epochs_per_dispatch=32, repeats=5,
     return images / _record(name, times)
 
 
-def bench_alexnet_step(batch=128, steps=16, repeats=5):
+def bench_alexnet_step(batch=128, steps=16, repeats=5, prof_steps=12,
+                       prefetch_depth=2):
     """AlexNet per-launch-path throughput (dispatch-overhead diagnostic)
-    plus the FLOPs-per-step probe for MFU accounting."""
+    with the async input pipeline OFF vs ON (ISSUE 3): interleaved A/B
+    windows of the same step loop, synchronous serving vs a
+    MinibatchPrefetcher at ``prefetch_depth``, plus fenced StepProfiler
+    windows recording each mode's data_wait share of step time — the
+    win the prefetcher claims must be visible in this JSON.  Also runs
+    the FLOPs-per-step probe for MFU accounting."""
     from veles_tpu import loader as loader_mod
-    _stamp("building alexnet_step (per-launch)")
-    wf = _make_alexnet(batch)
+    _stamp("building alexnet_step (per-launch, prefetch A/B)")
+    wf = _make_alexnet(batch, prefetch_depth=0)
     step = wf.fused_step
 
-    def next_train_step():
-        while True:
+    def run_steps(n):
+        done = 0
+        while done < n:
             wf.loader.run()
             if wf.loader.minibatch_class == loader_mod.TRAIN:
                 step.run()
-                return
-
-    next_train_step()  # compile
-    next_train_step()
-    _sync(step)
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            next_train_step()
+                done += 1
         _sync(step)
-        times.append(time.perf_counter() - t0)
-    ips = batch * steps / _record("alexnet_step", times)
+
+    def attach():
+        return wf.attach_prefetcher(depth=prefetch_depth,
+                                    stage_to_device=True)
+
+    run_steps(2)                 # compile + warmup (sync variant)
+    pf = attach()
+    run_steps(2)                 # warm the device-staged idx/size/seed
+    pf.detach()                  # variant too (its own jit signature)
+    sync_times, pre_times = [], []
+    for _ in range(repeats):     # interleaved windows: shared-chip
+        t0 = time.perf_counter()  # contention drift cancels
+        run_steps(steps)
+        sync_times.append(time.perf_counter() - t0)
+        pf = attach()
+        t0 = time.perf_counter()
+        run_steps(steps)
+        pre_times.append(time.perf_counter() - t0)
+        pf.detach()
+    ips_sync = batch * steps / _record("alexnet_step_sync", sync_times)
+    ips_pre = batch * steps / _record("alexnet_step", pre_times)
+
+    def data_wait_pct(prefetch):
+        """Fenced profiler window: data_wait share of step time."""
+        pf = attach() if prefetch else None
+        prof = wf.attach_profiler()   # AFTER the prefetcher: data_wait
+        run_steps(prof_steps)         # = time blocked on the queue
+        prof.detach()
+        if pf is not None:
+            pf.detach()
+        return (prof.summary().get("phase_pct") or {}).get("data_wait")
+
+    dw_sync = data_wait_pct(False)
+    dw_pre = data_wait_pct(True)
     flops_per_step, flops_source = _xla_flops_per_step(step, wf, batch)
-    _stamp("alexnet_step: measured (flops via %s)" % flops_source)
-    return ips, flops_per_step, flops_source
+    _stamp("alexnet_step: measured (prefetch %.2fx, data_wait "
+           "%s%% -> %s%%; flops via %s)"
+           % (ips_pre / ips_sync, dw_sync, dw_pre, flops_source))
+    return {"alexnet_step_images_per_sec": round(ips_pre, 1),
+            "alexnet_step_sync_images_per_sec": round(ips_sync, 1),
+            "alexnet_step_prefetch_speedup": round(ips_pre / ips_sync, 3),
+            "alexnet_step_data_wait_pct": dw_pre,
+            "alexnet_step_sync_data_wait_pct": dw_sync,
+            "flops_per_step": flops_per_step,
+            "flops_source": flops_source}
 
 
 def bench_mnist(batch=512, epochs=24, n_train=16384, repeats=10):
@@ -690,10 +736,7 @@ def _stage_main(stage):
                                  name="alexnet_bf16")
         out = {"alexnet_bf16_images_per_sec": round(ips, 1)}
     elif stage == "alexnet_step":
-        ips, flops_per_step, flops_source = bench_alexnet_step(batch=BATCH)
-        out = {"alexnet_step_images_per_sec": round(ips, 1),
-               "flops_per_step": flops_per_step,
-               "flops_source": flops_source}
+        out = bench_alexnet_step(batch=BATCH)
     elif stage == "mnist":
         out = {"mnist_anchor_images_per_sec": round(bench_mnist(), 1)}
     elif stage == "flash_attention":

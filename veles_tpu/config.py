@@ -258,6 +258,13 @@ root.update({
             # preferred compute dtype on TPU
             "dtype": "float32",
         },
+        "loader": {
+            # background minibatch prefetch lookahead on the per-step
+            # training path (loader/prefetch.py): how many minibatches a
+            # worker thread prepares + device_puts ahead of the consumer.
+            # 0 = exactly today's synchronous serving.
+            "prefetch_depth": 2,
+        },
         "trace": {"enabled": False, "file": None},
         "timings": set(),
         "random_seed": 1234,

@@ -10,6 +10,7 @@ from .image import (ImageLoader, FileImageLoader,           # noqa: F401
                     ImageLoaderMSE, FileImageLoaderMSE)
 from .pickles import (PicklesLoader, Hdf5Loader,            # noqa: F401
                       FileListLoader)
+from .prefetch import MinibatchPrefetcher, PrefetchError    # noqa: F401
 from .saver import MinibatchesSaver, MinibatchesLoader      # noqa: F401
 from .stream import StreamLoader                            # noqa: F401
 from .sound import SndFileLoader                            # noqa: F401

@@ -56,6 +56,11 @@ class Loader(Unit, IResultProvider):
     INDEX_DTYPE = numpy.int32
 
     hide_from_registry = True
+    #: standalone ``run()`` may be wrapped by a background
+    #: :class:`~veles_tpu.loader.prefetch.MinibatchPrefetcher`; loaders
+    #: whose run() has side channels beyond minibatch serving (stream/
+    #: interactive feeds that can stop the workflow) opt out
+    supports_prefetch = True
 
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)
@@ -99,6 +104,10 @@ class Loader(Unit, IResultProvider):
     def init_unpickled(self):
         super().init_unpickled()
         self.pending_minibatches_ = collections.defaultdict(list)
+        # attached by MinibatchPrefetcher (transient: a restored
+        # workflow re-attaches through StandardWorkflow.initialize)
+        self.prefetcher_ = None
+        self.prefetch_staged_ = None
 
     def __setstate__(self, state):
         # snapshots written before the valid_ended Bool existed must still
@@ -186,7 +195,9 @@ class Loader(Unit, IResultProvider):
             self.prepare_restored_dataset()
 
     def run(self):
-        """Serve one minibatch (standalone mode)."""
+        """Serve one minibatch (standalone mode).  With a
+        MinibatchPrefetcher attached this whole method runs ahead on a
+        worker thread and run() merely installs the next ready item."""
         self.serve_next_minibatch(None)
         # standalone: the minibatch is consumed synchronously, so it is no
         # longer outstanding when the epoch flags update

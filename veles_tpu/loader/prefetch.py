@@ -1,0 +1,431 @@
+"""MinibatchPrefetcher: overlap host minibatch preparation with device
+compute on the per-step training path.
+
+The synchronous per-step loop runs ``loader.run() -> device_put ->
+step.run()`` strictly serially, so the accelerator idles for the whole
+host prepare+transfer on every minibatch (the ``data_wait`` phase the
+StepProfiler measures).  VELES's own master-slave design pipelined
+minibatch serving against compute; this is the standalone-mode
+equivalent: a worker thread serves minibatches ``depth`` steps ahead
+into a bounded queue, issues ``jax.device_put`` for each one (the H2D
+copy overlaps the previous step's compute under JAX async dispatch),
+and the consumer merely installs the next ready snapshot.
+
+**Twin serving** keeps the semantics exact without duplicating any
+loader logic: the worker drives a shadow *twin* of the loader — same
+class, same ``__dict__`` (so the generator state is SHARED by
+reference: ``prng``, ``shuffled_indices``, ``failed_minibatches``,
+``labels_mapping``, class geometry) — but with private minibatch
+Arrays, epoch-flag Bools and counters, so the worker never writes a
+surface the consumer might concurrently read.  Each production calls
+the loader's own, unmodified ``run()`` on the twin (index advance,
+requeue pop, ``fill_minibatch``, normalization, label mapping, epoch
+flags) and snapshots the result into an immutable item; consumption
+installs the snapshot into the real loader — identical minibatch
+order, identical shuffles, identical flag edges, one step later in
+wall-clock only.
+
+Guarantees:
+
+- ``prefetch_depth = 0`` (or `attach` returning None) leaves the
+  loader byte-for-byte on today's synchronous path;
+- the shuffled minibatch sequence, failed-minibatch requeue
+  (`loader/base.py` ``failed_minibatches``) and epoch metrics are
+  identical to the synchronous path (asserted by
+  ``tests/test_prefetch.py``);
+- master/slave index serving still works: the first distributed call
+  (``generate_data_for_slave`` / ``apply_data_from_master``) detaches
+  the prefetcher and falls back to synchronous serving — the
+  distributed protocol already pipelines at the job level;
+- worker exceptions re-raise on the consumer thread (original
+  traceback chained);
+- ``stop()`` joins the worker without losing queued minibatches (they
+  are consumed first on restart); the workflow-finish hook stops the
+  worker so no thread outlives ``Workflow.run()``.
+"""
+
+import logging
+import queue as queue_mod
+import threading
+import time
+import weakref
+
+import numpy
+
+from ..config import root
+from ..memory import Array
+from ..mutable import Bool
+from ..observability.registry import REGISTRY
+
+logger = logging.getLogger("prefetch")
+
+#: per-minibatch output surfaces the twin gets private copies of
+_OUT_ARRAYS = ("minibatch_data", "minibatch_labels", "minibatch_indices",
+               "minibatch_targets")
+_OUT_FLAGS = ("last_minibatch", "epoch_ended", "train_ended", "valid_ended")
+#: instance-dict wrappers that must never leak onto the twin
+_WRAPPED = ("run", "stop", "generate_data_for_slave",
+            "apply_data_from_master")
+#: how long blocked queue ops sleep before re-checking stop/failure
+_POLL_S = 0.05
+
+
+class PrefetchError(RuntimeError):
+    """The prefetch worker died and the original exception object was
+    already delivered once — raised on any further serve attempt."""
+
+
+def _worker_main(ref, stop_evt):
+    """Worker thread entry.  Holds only a WEAK reference between
+    cycles: a run-abandoned workflow (built, stepped a few times,
+    dropped) must stay garbage-collectable — a strong ref here would
+    pin the whole unit graph and keep the thread alive forever.  When
+    the prefetcher is collected the worker exits on its next wake-up."""
+    idle = 0
+    while not stop_evt.is_set():
+        self = ref()
+        if self is None:
+            return
+        try:
+            idle = self._work_once(idle)
+        except BaseException as exc:  # noqa: BLE001 — re-raised at consume
+            self._failure = exc
+            return
+        del self
+
+
+class _Item:
+    """One prefetched minibatch: everything the synchronous path would
+    have left on the loader after ``run()``."""
+
+    __slots__ = ("offset", "size", "cls", "epoch", "served",
+                 "global_offset", "flags", "arrays", "raw_labels",
+                 "padded", "staged")
+
+
+class MinibatchPrefetcher:
+    """Background producer for one loader's standalone serving path.
+
+    Constructing one attaches it (mirrors StepProfiler); use
+    :meth:`attach` to honor the ``prefetch_depth`` knob and loader
+    capability in one call.  ``stage_to_device`` issues
+    ``jax.device_put`` (optionally onto ``sharding`` — the step's batch
+    sharding) from the worker so the transfer overlaps compute;
+    without it items carry host copies only.
+    """
+
+    @classmethod
+    def attach(cls, loader, depth=None, **kwargs):
+        """Attach per config; returns None (no-op) when ``depth`` <= 0
+        or the loader opts out (``supports_prefetch = False``)."""
+        if depth is None:
+            depth = int(root.common.loader.get("prefetch_depth", 2) or 0)
+        if depth <= 0:
+            return None
+        if not getattr(loader, "supports_prefetch", True):
+            logger.debug("%s opts out of prefetching", loader)
+            return None
+        existing = getattr(loader, "prefetcher_", None)
+        if existing is not None:
+            existing.detach()
+        return cls(loader, depth=depth, **kwargs)
+
+    def __init__(self, loader, depth=2, stage_to_device=True,
+                 sharding=None, registry=None):
+        if depth <= 0:
+            raise ValueError("depth must be >= 1 (use attach() for the "
+                             "0-disables-prefetch convention)")
+        self._loader = loader
+        self.depth = int(depth)
+        self._stage = bool(stage_to_device)
+        self._sharding = sharding
+        self._queue = queue_mod.Queue(maxsize=self.depth)
+        self._carry = None        # produced but not yet enqueued (stop())
+        self._thread = None
+        self._stop_evt = threading.Event()
+        self._failure = None
+        self._lock = threading.Lock()   # worker lifecycle transitions
+        self.produced = 0
+        self.consumed = 0
+        self.restarts = 0
+        self.wait_s = 0.0         # consumer time blocked on the queue
+        reg = registry or REGISTRY
+        lbl = {"loader": getattr(loader, "name", type(loader).__name__)}
+        self._g_queue = reg.gauge(
+            "veles_loader_prefetch_queue", "Prefetched minibatches ready",
+            ("loader",)).labels(**lbl)
+        self._c_items = reg.counter(
+            "veles_loader_prefetch_items_total",
+            "Minibatches served through the prefetch queue",
+            ("loader",)).labels(**lbl)
+        self._c_wait = reg.counter(
+            "veles_loader_prefetch_wait_seconds_total",
+            "Consumer time blocked waiting on the prefetch queue",
+            ("loader",)).labels(**lbl)
+        self._twin = self._make_twin()
+        self._install_wrappers()
+        loader.prefetcher_ = self
+
+    # -- twin ----------------------------------------------------------------
+    def _make_twin(self):
+        """A worker-private serving view of the loader: shared generator
+        state, private output surfaces."""
+        ld = self._loader
+        twin = object.__new__(type(ld))
+        state = dict(ld.__dict__)
+        for name in _WRAPPED:
+            state.pop(name, None)   # never inherit instance wrappers
+        twin.__dict__.update(state)
+        import collections
+        twin.pending_minibatches_ = collections.defaultdict(list)
+        for name in _OUT_ARRAYS:
+            arr = getattr(ld, name, None)
+            if not isinstance(arr, Array):
+                continue
+            fresh = Array()
+            host = arr.mem
+            if host is None and arr:
+                host = arr.map_read()
+            if host is not None:
+                fresh.reset(numpy.array(host, copy=True))
+            setattr(twin, name, fresh)
+        for name in _OUT_FLAGS:
+            flag = getattr(ld, name, None)
+            if isinstance(flag, Bool):
+                setattr(twin, name, Bool(bool(flag)))
+        twin.raw_minibatch_labels = list(ld.raw_minibatch_labels)
+        # FullBatch device gather: its jitted gather writes through
+        # ``_gather_targets_`` — retarget the loader's Arrays onto the
+        # twin's private ones (sources and the jit itself stay shared)
+        targets = getattr(ld, "_gather_targets_", None)
+        if targets is not None:
+            remap = {id(getattr(ld, n, None)): getattr(twin, n)
+                     for n in _OUT_ARRAYS if getattr(ld, n, None)
+                     is not None}
+            twin._gather_targets_ = [remap.get(id(a), a) for a in targets]
+        return twin
+
+    # -- wrappers ------------------------------------------------------------
+    def _install_wrappers(self):
+        ld = self._loader
+        # pre-existing instance-level overrides (e.g. an outer
+        # profiler's wrapper) must survive a detach round-trip
+        self._origs = {name: ld.__dict__.get(name)
+                       for name in _WRAPPED}
+
+        def _run():
+            return self._consume()
+
+        def _stop():
+            # workflow finished: join the worker (no leaked threads);
+            # queued items survive for a subsequent run()
+            self.stop()
+            return type(ld).stop(ld)
+
+        def _gdfs(slave=None):
+            self.detach(reason="master-side slave serving")
+            return type(ld).generate_data_for_slave(ld, slave)
+
+        def _adfm(data):
+            self.detach(reason="slave-side master serving")
+            return type(ld).apply_data_from_master(ld, data)
+
+        self._wrappers = {"run": _run, "stop": _stop,
+                          "generate_data_for_slave": _gdfs,
+                          "apply_data_from_master": _adfm}
+        for fn in self._wrappers.values():
+            # Pickleable.__getstate__ drops transient_ callables, so a
+            # snapshot taken mid-run never tries to pickle the worker
+            fn.transient_ = True
+        for name, fn in self._wrappers.items():
+            setattr(ld, name, fn)
+
+    # -- production (worker thread) ------------------------------------------
+    def _produce(self):
+        tw = self._twin
+        tw.run()    # the loader's own standalone serving logic, verbatim
+        it = _Item()
+        it.offset = tw.minibatch_offset
+        it.size = tw.minibatch_size
+        it.cls = tw.minibatch_class
+        it.epoch = tw.epoch_number
+        it.served = tw.samples_served
+        it.global_offset = tw._global_offset
+        it.flags = tuple(bool(getattr(tw, n)) for n in _OUT_FLAGS)
+        it.raw_labels = (list(tw.raw_minibatch_labels[:it.size])
+                         if tw.has_labels else None)
+        idx = tw.minibatch_indices
+        it.arrays = [("minibatch_indices",
+                      numpy.array(idx.mem, copy=True)
+                      if idx.mem is not None else None, None)]
+        it.padded = it.staged = None
+        deferred = (getattr(tw, "defer_device_gather", False) and
+                    getattr(tw, "_use_device", False))
+        if deferred:
+            # gather-in-step path: the data never leaves HBM residency;
+            # stage the *indices* (and the size scalar) instead so the
+            # step's host work is one dict lookup
+            it.padded = tw._padded_indices_
+            if self._stage:
+                import jax
+                it.staged = (jax.device_put(it.padded),
+                             jax.device_put(numpy.int32(it.size)))
+        else:
+            for name in ("minibatch_data", "minibatch_labels",
+                         "minibatch_targets"):
+                arr = getattr(tw, name, None)
+                if not isinstance(arr, Array) or not arr:
+                    continue
+                it.arrays.append((name,) + self._snap(arr))
+        return it
+
+    def _snap(self, arr):
+        """(host, device) snapshot of one output Array.  Device-fresh
+        values (the fullbatch jitted gather's outputs — a new buffer per
+        call) ride as-is; host-fresh values are copied out of the twin's
+        reused buffer and, when staging, device_put so the H2D overlaps
+        the in-flight step."""
+        if arr._device_dirty_ and arr._devmem_ is not None:
+            return None, arr._devmem_
+        host = numpy.array(arr.mem, copy=True)
+        if self._stage:
+            import jax
+            if self._sharding is not None:
+                return None, jax.device_put(host, self._sharding)
+            return None, jax.device_put(host)
+        return host, None
+
+    def _work_once(self, idle_polls):
+        """One produce-or-enqueue cycle; returns the next idle count.
+        The put timeout backs off while the consumer is away so an idle
+        worker costs ~nothing."""
+        if self._carry is None:
+            self._carry = self._produce()
+            self.produced += 1
+        timeout = min(_POLL_S * (1 + idle_polls), 1.0)
+        try:
+            self._queue.put(self._carry, timeout=timeout)
+        except queue_mod.Full:
+            return idle_polls + 1
+        self._carry = None
+        return 0
+
+    # -- consumption (main thread) -------------------------------------------
+    def _ensure_worker(self):
+        t = self._thread
+        if t is not None and t.is_alive():
+            return
+        if self._failure is not None:
+            # a dead worker's remaining queue drains, but it is never
+            # restarted — the twin's serving state is suspect
+            if self._queue.empty():
+                self._reraise()
+            return
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            if self.produced > 0:
+                self.restarts += 1
+            self._stop_evt = threading.Event()
+            self._thread = threading.Thread(
+                target=_worker_main,
+                args=(weakref.ref(self), self._stop_evt), daemon=True,
+                name="veles-prefetch-%s" % getattr(
+                    self._loader, "name", "loader"))
+            self._thread.start()
+
+    def _reraise(self):
+        exc, self._failure = self._failure, PrefetchError(
+            "prefetch worker for %s already died" % self._loader)
+        raise exc
+
+    def _consume(self):
+        self._ensure_worker()
+        t0 = time.perf_counter()
+        while True:
+            try:
+                it = self._queue.get(timeout=_POLL_S)
+                break
+            except queue_mod.Empty:
+                if self._failure is not None:
+                    self._reraise()
+                self._ensure_worker()
+        waited = time.perf_counter() - t0
+        self.wait_s += waited
+        self._c_wait.inc(waited)
+        self._c_items.inc()
+        self._g_queue.set(self._queue.qsize())
+        self._install(it)
+
+    def _install(self, it):
+        ld = self._loader
+        ld.minibatch_offset = it.offset
+        ld.minibatch_size = it.size
+        ld.minibatch_class = it.cls
+        ld.epoch_number = it.epoch
+        ld.samples_served = it.served
+        ld._global_offset = it.global_offset
+        for name, host, dev in it.arrays:
+            arr = getattr(ld, name)
+            if dev is not None:
+                arr.devmem = dev        # host copy pulled lazily on read
+            elif host is not None:
+                arr.mem = host
+        if it.raw_labels is not None:
+            ld.raw_minibatch_labels[:len(it.raw_labels)] = it.raw_labels
+        if it.padded is not None:
+            ld._padded_indices_ = it.padded
+        ld.prefetch_staged_ = it.staged
+        # flags last: downstream Bool expressions must see a complete
+        # minibatch when an edge callback fires
+        for name, value in zip(_OUT_FLAGS, it.flags):
+            flag = getattr(ld, name)
+            flag <<= value
+        self.consumed += 1
+
+    # -- lifecycle -----------------------------------------------------------
+    def stop(self):
+        """Join the worker; queued items are kept and consumed first if
+        serving resumes."""
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10)
+        self._thread = None
+
+    def detach(self, reason=None):
+        """Restore the loader's synchronous serving path.  Not-yet-
+        consumed lookahead is discarded; the generator resumes from the
+        last *consumed* minibatch (exactly where the synchronous path
+        would be)."""
+        self.stop()
+        ld = self._loader
+        for name, fn in self._wrappers.items():
+            if ld.__dict__.get(name) is fn:
+                del ld.__dict__[name]
+                orig = self._origs.get(name)
+                if orig is not None:
+                    ld.__dict__[name] = orig
+        # the loader's _global_offset sits at the last CONSUMED
+        # minibatch, so synchronous serving re-generates (never skips)
+        # anything that was still queued; prng draws that the twin spent
+        # on a lookahead shuffle are not rewound — a valid (possibly
+        # different) permutation for the epoch in progress
+        ld.prefetch_staged_ = None
+        ld.prefetcher_ = None
+        if reason:
+            logger.debug("prefetcher for %s detached (%s)", ld, reason)
+
+    def stats(self):
+        return {"depth": self.depth,
+                "produced": self.produced,
+                "consumed": self.consumed,
+                "queued": self._queue.qsize(),
+                "restarts": int(self.restarts),
+                "consumer_wait_s": round(self.wait_s, 4),
+                "staging": bool(self._stage)}
+
+    def __repr__(self):
+        return ("<MinibatchPrefetcher depth=%d of %r (%d/%d "
+                "produced/consumed)>" % (self.depth, self._loader,
+                                         self.produced, self.consumed))

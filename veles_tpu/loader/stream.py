@@ -20,6 +20,9 @@ class StreamLoader(Loader):
     """Serves externally-pushed batches (TEST class, no epochs)."""
 
     MAPPING = "stream_loader"
+    # run() blocks on an external producer and may stop the workflow —
+    # serving it from a prefetch worker would race both side channels
+    supports_prefetch = False
 
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)

@@ -6,7 +6,12 @@ hide: recompiles and host/device transfer stalls.  This profiler makes
 both visible for any veles_tpu workflow by wrapping the two hot units —
 the loader and the fused train step — and splitting every step into:
 
-- **data-wait**: host-side minibatch preparation (the loader's run);
+- **data-wait**: host-side minibatch preparation (the loader's run).
+  With a :class:`~veles_tpu.loader.prefetch.MinibatchPrefetcher`
+  attached (attach it BEFORE the profiler), the loader's run() merely
+  pops the prefetch queue, so this phase measures time the step loop is
+  *actually blocked* on input — the number the prefetcher exists to
+  drive to zero;
 - **host**: python + dispatch time of the step's ``run()`` (with XLA's
   async dispatch this is the enqueue cost, not the math);
 - **device**: the remaining device-compute tail, measured by fencing on
@@ -262,10 +267,12 @@ class StepProfiler:
                 continue
             if obj.__dict__.get("run") is wrapper:
                 del obj.__dict__["run"]
-                # a pre-existing instance-level run (e.g. an OUTER
-                # profiler's wrapper) must come back
+                # a pre-existing instance-level run (an OUTER profiler's
+                # wrapper, or a MinibatchPrefetcher's plain-function
+                # consume wrapper — no __func__) must come back
                 if orig is not None and \
-                        orig.__func__ is not type(obj).run:
+                        getattr(orig, "__func__", None) is not \
+                        type(obj).run:
                     obj.__dict__["run"] = orig
 
     def summary(self):
@@ -285,4 +292,7 @@ class StepProfiler:
                 "device": round(100 * self.device_s / total, 1)}
         if self.peak_memory:
             out["device_peak_memory_bytes"] = dict(self.peak_memory)
+        prefetcher = getattr(self.loader, "prefetcher_", None)
+        if prefetcher is not None:
+            out["prefetch"] = prefetcher.stats()
         return out

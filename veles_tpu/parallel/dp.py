@@ -51,6 +51,12 @@ class DistributedTrainStep(FusedTrainStep):
             m, self._params_, self._opt_, self.model_axis, self.tp_mode)
         batch_shard = mesh_mod.batch_sharding(m, self.data_axis)
         label_shard = batch_shard
+        # input-pipeline hooks (loader/prefetch.py): single-host, the
+        # prefetch worker device_puts minibatches straight onto the
+        # batch sharding; multi-host, the step re-places host batches
+        # itself below, so prefetch staging must stay off
+        self._batch_sharding_ = None if multihost else batch_shard
+        self._prefetch_unsupported_ = multihost
         mesh_mod.register_mesh_metrics(
             m, getattr(self._workflow, "name", "-"))
 

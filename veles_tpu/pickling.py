@@ -30,6 +30,12 @@ class Pickleable:
                 continue
             if callable(value) and getattr(value, "__self__", None) is self:
                 continue  # bound methods of self are rebuilt on restore
+            if callable(value) and getattr(value, "transient_", False):
+                # instrumentation wrappers installed over methods (e.g.
+                # a MinibatchPrefetcher's run()) mark themselves
+                # transient: they hold threads/queues and are re-attached
+                # after restore, never pickled
+                continue
             state[key] = value
         return state
 

@@ -431,15 +431,23 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, '{"error": "bboxer not configured"}')
             return
         length = int(self.headers.get("Content-Length", 0))
+        if length > _BBOXER_MAX_BODY:
+            # bboxes.json is rewritten whole on every save: an oversized
+            # body would balloon the store (and buffer in RAM) — no
+            # legitimate box list comes anywhere near this
+            self._send(413, '{"error": "bbox payload too large"}')
+            return
         try:
             payload = json.loads(self.rfile.read(length) or b"{}")
             name = os.path.basename(str(payload["image"]))
             boxes = payload["boxes"]
             if not isinstance(boxes, list) or not all(
                     isinstance(b, list) and len(b) == 5 and
-                    all(isinstance(c, (int, float)) for c in b[:4])
+                    all(isinstance(c, (int, float)) for c in b[:4]) and
+                    isinstance(b[4], str) and
+                    len(b[4]) <= _BBOXER_MAX_LABEL
                     for b in boxes):
-                raise ValueError("boxes must be [x, y, w, h, label]")
+                raise ValueError("boxes must be [x, y, w, h, label:str]")
         except (KeyError, ValueError, TypeError):
             self._send(400, '{"error": "bad bbox payload"}')
             return
@@ -462,6 +470,13 @@ class _Handler(BaseHTTPRequestHandler):
             os.replace(tmp, store)
         self._send(200, '{"ok": true}')
 
+
+#: /bboxer/save hardening: labels are persisted verbatim into
+#: bboxes.json and echoed back into the canvas UI — cap them, and bound
+#: the whole body (the server is threaded; each request buffers its
+#: body in RAM before parsing)
+_BBOXER_MAX_LABEL = 256
+_BBOXER_MAX_BODY = 1 << 20
 
 #: the bboxer canvas UI (single self-contained page, no toolchain —
 #: the reference built this as a node/gulp app)

@@ -263,6 +263,30 @@ class Workflow(Container):
         self.run()
         callback(self.generate_data_for_master())
 
+    # -- input pipeline ------------------------------------------------------
+    def attach_prefetcher(self, loader=None, **kwargs):
+        """Attach a background
+        :class:`~veles_tpu.loader.prefetch.MinibatchPrefetcher` to this
+        workflow's loader (``root.common.loader.prefetch_depth`` deep
+        unless ``depth=`` is given; 0 disables).  Call after
+        ``initialize`` — minibatch buffers and the device path must
+        exist.  When the training step exposes a batch sharding
+        (``_batch_sharding_``, set by the distributed per-step trainer)
+        prefetched minibatches are device_put straight onto it.  Attach
+        BEFORE ``attach_profiler`` so the profiler's data-wait phase
+        measures time blocked on the prefetch queue.  Returns the
+        prefetcher, or None when disabled/unsupported."""
+        from .loader.prefetch import MinibatchPrefetcher
+        if loader is None:
+            loader = getattr(self, "loader", None)
+        if loader is None:
+            raise ValueError("no loader to prefetch for %r" % self)
+        step = getattr(self, "fused_step", None)
+        kwargs.setdefault("sharding",
+                          getattr(step, "_batch_sharding_", None))
+        self.prefetcher_ = MinibatchPrefetcher.attach(loader, **kwargs)
+        return self.prefetcher_
+
     # -- observability -------------------------------------------------------
     def attach_profiler(self, **kwargs):
         """Instrument this workflow's training step with a

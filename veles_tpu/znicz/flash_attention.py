@@ -46,11 +46,19 @@ DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 _MIN_BLOCK = 32         # >= f32 sublane tile; smallest worthwhile tile
 _STAT_LANES = 128       # per-row stats (lse, delta) ride a full lane
-                        # dim: Mosaic requires block last-dims (8, 128)
-                        # tileable, so a [BH, T] row vector can't be
-                        # blocked (1, block_q) — broadcast across 128
-                        # lanes at the kernel boundary instead (the
-                        # canonical TPU flash layout)
+                        # dim INSIDE the kernels: Mosaic requires block
+                        # last-dims (8, 128) tileable, so a [BH, T] row
+                        # vector can't be blocked (1, block_q).  The
+                        # forward's lse OUTPUT does not pay the 128x
+                        # broadcast in HBM though: when block_q divides
+                        # into whole 128-lane rows the kernel emits a
+                        # compact [BH, T//128, 128] block layout (the T
+                        # axis folded into lanes, one f32 per row —
+                        # 134 MB -> 1 MB at BH=8, T=32k) and only the
+                        # backward's kernel-boundary broadcast
+                        # materializes lanes, transiently.  Small-T
+                        # fallback blocks (32/64) keep the broadcast
+                        # layout.
 _NEG_INF = float("-inf")
 _warned_shapes = set()
 
@@ -132,7 +140,7 @@ def _qband_size(block_q, block_k, window):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                 acc_scr, *, scale, causal, block_q, block_k,
-                window=None, window_grid=None):
+                window=None, window_grid=None, compact_stats=False):
     from jax.experimental import pallas as pl
 
     iq, j = pl.program_id(1), pl.program_id(2)
@@ -180,7 +188,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
         lse = jnp.where(jnp.isneginf(m), 0.0, m) + jnp.log(safe_l)
-        lse_ref[0] = jnp.broadcast_to(lse, (block_q, _STAT_LANES))
+        if compact_stats:
+            # fold the [BQ, 1] column into whole 128-lane rows: one f32
+            # per query row in HBM instead of a 128x lane broadcast (a
+            # single in-VMEM relayout per Q block — negligible next to
+            # the saved HBM write traffic)
+            lse_ref[0] = lse.reshape(block_q // _STAT_LANES, _STAT_LANES)
+        else:
+            lse_ref[0] = jnp.broadcast_to(lse, (block_q, _STAT_LANES))
 
 
 def _struct(shape, dtype, vma):
@@ -208,9 +223,14 @@ def _flash_fwd_bh(q, k, v, scale, causal, block_q, block_k, vma=None,
         window_grid = window
         n_inner = n_k if window is None else _kband_size(
             block_q, block_k, window)
+    # compact stats layout whenever each Q block covers whole 128-lane
+    # rows (default 256/128 blocks do; the 32/64 fallbacks keep the
+    # lane-broadcast layout) — see the _STAT_LANES note
+    compact = block_q % _STAT_LANES == 0
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, window=window, window_grid=window_grid)
+        block_k=block_k, window=window, window_grid=window_grid,
+        compact_stats=compact)
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     if window_grid is None:
         k_index = lambda b, i, j: (b, j, 0)  # noqa: E731
@@ -219,19 +239,27 @@ def _flash_fwd_bh(q, k, v, scale, causal, block_q, block_k, vma=None,
             b, jnp.clip(_kband_start(i, block_q, block_k, window_grid)
                         + j, 0, n_k - 1), 0)
     kspec = pl.BlockSpec((1, block_k, d), k_index)
-    qrow = pl.BlockSpec((1, block_q, _STAT_LANES),
-                        lambda b, i, j: (b, i, 0))
+    if compact:
+        lse_spec = pl.BlockSpec((1, block_q // _STAT_LANES, _STAT_LANES),
+                                lambda b, i, j: (b, i, 0))
+        lse_shape = (bh, t // _STAT_LANES, _STAT_LANES)
+    else:
+        lse_spec = pl.BlockSpec((1, block_q, _STAT_LANES),
+                                lambda b, i, j: (b, i, 0))
+        lse_shape = (bh, t, _STAT_LANES)
     out, lse = pl.pallas_call(
         kernel, grid=(bh, n_q, n_inner),
         in_specs=[qspec, kspec, kspec],
-        out_specs=[qspec, qrow],
+        out_specs=[qspec, lse_spec],
         out_shape=[_struct((bh, t, d), q.dtype, vma),
-                   _struct((bh, t, _STAT_LANES), jnp.float32, vma)],
+                   _struct(lse_shape, jnp.float32, vma)],
         scratch_shapes=[pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret())(q, k, v)
-    return out, lse[:, :, 0]
+    # contiguous fold back to [BH, T] rows (free: a metadata reshape in
+    # the compact layout, a lane slice otherwise)
+    return out, (lse.reshape(bh, t) if compact else lse[:, :, 0])
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,

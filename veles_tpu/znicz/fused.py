@@ -324,22 +324,48 @@ class FusedTrainStep(Unit, IResultProvider):
                 jnp.full((), jnp.inf, jnp.float32))
 
     # -- run -----------------------------------------------------------------
+    def _staged_seed_arg(self):
+        """Device-resident seed scalar for the staged gather path.  The
+        per-step H2D of the (tiny) seed costs a host sync point on
+        tunneled devices; instead the NEXT train seed is device_put
+        right after each dispatch, so the transfer rides under the
+        in-flight step's compute.  Falls back to a synchronous
+        device_put when nothing is staged (first step, restored run)."""
+        import jax
+        staged = getattr(self, "_staged_seed_", None)
+        if staged is not None and staged[0] == self._seed_counter:
+            arg = staged[1]
+        else:
+            arg = jax.device_put(numpy.int32(self._seed_counter))
+        nxt = (self._seed_counter + 1) % 0x7FFF0000
+        self._staged_seed_ = (nxt, jax.device_put(numpy.int32(nxt)))
+        return arg
+
     def run(self):
         size = int(self.minibatch_size)
         train = self.minibatch_class == loader_mod.TRAIN
         if getattr(self, "_use_gather_", False):
-            idx = self.gather_loader._padded_indices_
+            # a MinibatchPrefetcher stages idx/size on device ahead of
+            # the step (the H2D overlapped the previous step's compute);
+            # the synchronous path passes host values exactly as before
+            staged = getattr(self.gather_loader, "prefetch_staged_", None)
+            if staged is not None:
+                idx, size_arg = staged
+            else:
+                idx, size_arg = self.gather_loader._padded_indices_, size
             if train:
                 self._seed_counter = (self._seed_counter + 1) % 0x7FFF0000
+                seed_arg = (self._staged_seed_arg() if staged is not None
+                            else self._seed_counter)
                 (self._params_, self._opt_, self._macc_, loss, out) = \
                     self._train_step_g_(
                         self._data_dev_, self._y_dev_, self._params_,
-                        self._opt_, self._macc_, idx, size,
-                        self._seed_counter, float(self.lr_scale))
+                        self._opt_, self._macc_, idx, size_arg,
+                        seed_arg, float(self.lr_scale))
             else:
                 self._macc_, loss, out = self._eval_step_g_(
                     self._data_dev_, self._y_dev_, self._params_,
-                    self._macc_, idx, size)
+                    self._macc_, idx, size_arg)
             self.loss = loss
             self.output.devmem = out
             if bool(self.last_minibatch):

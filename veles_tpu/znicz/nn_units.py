@@ -68,7 +68,11 @@ def resolve_use_pallas(setting, device, tpu_auto):
     backend = getattr(device, "BACKEND", None)
     if backend is None:  # unit not initialized (direct apply/trace)
         import jax
-        return jax.default_backend() == "tpu"
+        # mirror AutoDevice.pick: anything that is not the CPU platform
+        # (tpu, or a tunneled transport like axon) counts as the TPU —
+        # otherwise units traced without a device on such platforms
+        # would take the O(T^2) oracle instead of flash attention
+        return jax.default_backend() != "cpu"
     return backend == "tpu"
 
 

@@ -132,6 +132,10 @@ class StandardWorkflow(Workflow):
         self.epoch_scan = kwargs.get("epoch_scan", False)
         self.decision_config = dict(kwargs.get("decision", {}))
         self.loader_config = dict(kwargs.get("loader", {}))
+        # async input pipeline lookahead for the per-step path; None =
+        # follow root.common.loader.prefetch_depth (default 2, 0 = sync)
+        self.prefetch_depth = self.loader_config.pop("prefetch_depth",
+                                                     None)
         self.trainer_config = dict(kwargs.get("trainer", {}))
         self.snapshotter_config = kwargs.get("snapshotter")  # dict|None
         self.snapshotter = None
@@ -344,7 +348,28 @@ class StandardWorkflow(Workflow):
     def initialize(self, device=None, **kwargs):
         if self.restored_from_snapshot:
             self._relink_gates()
-        return super().initialize(device=device, **kwargs)
+        result = super().initialize(device=device, **kwargs)
+        self._maybe_attach_prefetcher(device)
+        return result
+
+    def _maybe_attach_prefetcher(self, device):
+        """Overlap host minibatch prep with device compute on the
+        per-step fused path (loader/prefetch.py).  The epoch-scan path
+        already amortizes the whole class into one dispatch, and the
+        multi-host distributed step re-places host batches itself, so
+        both skip."""
+        if not self.fused or self.epoch_scan or self.fused_step is None:
+            return
+        if getattr(self.fused_step, "_prefetch_unsupported_", False):
+            return
+        stage = bool(device is not None and
+                     getattr(device, "exists", False))
+        # getattr: snapshots written before the knob existed must still
+        # restore (None = follow the global config default)
+        self.attach_prefetcher(loader=self.loader,
+                               depth=getattr(self, "prefetch_depth",
+                                             None),
+                               stage_to_device=stage)
 
     def _relink_gates(self):
         """Derived Bool expressions flatten to constants on pickle; rebuild
