@@ -61,6 +61,12 @@ Also reported in the same JSON line:
   per-request dispatch on the same exported MNIST package, with
   ``serve_post_warmup_compiles`` recording the zero-recompile
   guarantee.
+- ``snapshot_stall_speedup`` + ``snapshot_stall_{sync,async}_ms`` +
+  ``snapshot_write_gz{9,6}_ms`` — the checkpointing path (ISSUE 4):
+  per-snapshot training-thread stall on the MNIST step loop with the
+  async capture/write split on vs off (interleaved windows; acceptance
+  >= 5x), and the synchronous durable-write time at gzip level 9 (the
+  old default) vs 6 (the new one).
 - ``spread`` — {name: [min_s, median_s, n]} per timed region, so
   contention claims are checkable from the JSON alone.
 
@@ -707,6 +713,100 @@ def bench_observability(batch=512, steps=64, repeats=5):
     return out
 
 
+def bench_snapshot(batch=512, steps=8, snaps=5, repeats=4):
+    """Per-snapshot training-thread stall, synchronous vs asynchronous
+    write (ISSUE 4 acceptance: >= 5x): the MNIST per-step loop with a
+    SnapshotterToFile driven explicitly, interleaved A/B windows (same
+    methodology as the observability stage) timing ONLY the export()
+    call — the stall the step loop actually eats.  The async window's
+    writer backlog drains untimed between windows so writer CPU never
+    leaks into the other mode's window.  Also records the
+    compression-level satellite: the synchronous durable-write time at
+    gzip level 9 (the old hardcoded default) vs level 6 (the new one),
+    interleaved the same way."""
+    import shutil
+    import tempfile
+    from veles_tpu import loader as loader_mod
+    from veles_tpu.backends import Device
+    from veles_tpu.config import root
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.snapshotter import SnapshotterToFile
+    from veles_tpu.znicz.samples import mnist as mnist_sample
+
+    _stamp("snapshot stage: building mnist step loop")
+    wf = mnist_sample.create_workflow(
+        loader={"minibatch_size": batch, "n_train": 8 * batch,
+                "n_valid": batch, "use_fixture": False,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 10 ** 9, "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    step = wf.fused_step
+
+    def run_steps(n):
+        done = 0
+        while done < n:
+            wf.loader.run()
+            if wf.loader.minibatch_class == loader_mod.TRAIN:
+                step.run()
+                done += 1
+        _sync(step)
+
+    run_steps(steps)  # compile + warmup
+    snapdir = tempfile.mkdtemp(prefix="veles-snap-bench-")
+    snap = SnapshotterToFile(wf, prefix="bench", directory=snapdir,
+                             time_interval=0, compression="gz")
+
+    def window(async_on, level=6):
+        snap.async_write = async_on
+        snap.compression_level = level
+        stalls = []
+        for _ in range(snaps):
+            run_steps(steps)
+            t0 = time.perf_counter()
+            snap._counter += 1     # unique filenames; run()'s job
+            snap.export()
+            stalls.append(time.perf_counter() - t0)
+        snap.flush()               # untimed backlog drain
+        return stalls
+
+    try:
+        window(True)               # warm both paths (capture + writer)
+        window(False)
+        sync_t, async_t, gz9_t, gz6_t = [], [], [], []
+        for _ in range(repeats):   # interleaved: contention drift cancels
+            sync_t += window(False)
+            async_t += window(True)
+        for _ in range(2):         # compression-level satellite (sync:
+            gz9_t += window(False, level=9)   # the stall IS the write)
+            gz6_t += window(False, level=6)
+        failure = snap._get_writer().take_failure()
+        if failure is not None:
+            raise failure
+        stats = snap.writer_stats() or {}
+    finally:
+        snap.stop()
+        wf.del_ref(snap)
+        shutil.rmtree(snapdir, ignore_errors=True)
+    _record("snapshot_stall_sync", sync_t)
+    _record("snapshot_stall_async", async_t)
+    _record("snapshot_write_gz9", gz9_t)
+    _record("snapshot_write_gz6", gz6_t)
+    med = statistics.median
+    out = {"snapshot_stall_sync_ms": round(med(sync_t) * 1e3, 3),
+           "snapshot_stall_async_ms": round(med(async_t) * 1e3, 3),
+           "snapshot_stall_speedup": round(med(sync_t) / med(async_t), 2),
+           "snapshot_write_gz9_ms": round(med(gz9_t) * 1e3, 3),
+           "snapshot_write_gz6_ms": round(med(gz6_t) * 1e3, 3),
+           "snapshot_gz6_write_speedup": round(med(gz9_t) / med(gz6_t),
+                                               2),
+           "snapshot_writer_coalesced": stats.get("coalesced"),
+           "snapshot_writer_written": stats.get("written")}
+    _stamp("snapshot stage: measured (stall %.1fx, gz9->gz6 %.1fx)"
+           % (out["snapshot_stall_speedup"],
+              out["snapshot_gz6_write_speedup"]))
+    return out
+
+
 def bench_liveness():
     """Stage 0 gate: one tiny jitted matmul with a real D2H flush.  If
     THIS can't finish, the tunnel is down and the orchestrator reports
@@ -755,6 +855,8 @@ def _stage_main(stage):
         out = bench_serving()
     elif stage == "observability":
         out = bench_observability()
+    elif stage == "snapshot":
+        out = bench_snapshot()
     else:
         raise SystemExit("unknown stage %r" % stage)
     out["spread"] = SPREAD
@@ -795,6 +897,9 @@ STAGE_PLAN = [
     # tracing+metrics+profiler overhead on the MNIST step loop (must
     # stay < 5%; ISSUE 2 acceptance) — optional tail like serving
     ("observability", 300),
+    # per-snapshot step-loop stall, sync vs async write + the gz9->gz6
+    # compression-level delta (ISSUE 4 acceptance: stall >= 5x)
+    ("snapshot", 300),
 ]
 
 

@@ -5,11 +5,13 @@ accounting all survive the pickle."""
 
 import glob
 import os
+import time
 
 import numpy
 import pytest
 
 from veles_tpu.backends import Device
+from veles_tpu.mutable import Bool
 from veles_tpu.prng import RandomGenerator
 from veles_tpu.snapshotter import SnapshotterToFile, restore
 from veles_tpu.znicz.standard_workflow import StandardWorkflow
@@ -132,3 +134,82 @@ def test_snapshot_weights_scored_the_named_metric(tmp_path):
 def test_import_rejects_missing(tmp_path):
     with pytest.raises(FileNotFoundError):
         SnapshotterToFile.import_file(str(tmp_path / "nope.pickle"))
+
+
+def test_throttle_uses_monotonic_clock(tmp_path, monkeypatch):
+    """_last_time bookkeeping must never read the wall clock (an NTP
+    step would suppress or force shots) — ToFile exports survive a
+    booby-trapped time.time (ISSUE 4 satellite; EventLog got the same
+    fix in PR 2)."""
+    import veles_tpu.snapshotter as snapshotter_mod
+
+    class _NoWallClock:
+        monotonic = staticmethod(time.monotonic)
+        perf_counter = staticmethod(time.perf_counter)
+        sleep = staticmethod(time.sleep)
+
+        @staticmethod
+        def time():
+            raise AssertionError("snapshot throttling read time.time()")
+
+    wf = build(2, tmp_path, snap=True)
+    monkeypatch.setattr(snapshotter_mod, "time", _NoWallClock)
+    snap = wf.snapshotter
+    snap.skip = Bool(False)
+    snap.time_interval = 10 ** 6
+    snap.run()                        # first shot: no prior timestamp
+    assert snap.destination is not None
+    first = snap.destination
+    snap.run()                        # throttled (fresh improvement off)
+    assert snap.destination == first
+    assert snap.flush()
+
+
+def test_compression_level_knob(tmp_path):
+    """root.common.snapshot.compression_level drives the codec; lower
+    levels must produce larger-or-equal files and still restore."""
+    from veles_tpu.config import root
+    sizes = {}
+    prior = root.common.snapshot.get("compression_level", 6)
+    try:
+        for level in (1, 9):
+            root.common.snapshot.compression_level = level
+            sub = tmp_path / ("lvl%d" % level)
+            sub.mkdir()
+            wf = build(2, sub, snap=True)
+            wf.run()
+            snaps = glob.glob(str(sub / "blob*.pickle.gz"))
+            assert snaps
+            sizes[level] = os.path.getsize(snaps[0])
+            restore(snaps[0])
+    finally:
+        root.common.snapshot.compression_level = prior
+    assert sizes[9] <= sizes[1]
+
+
+def test_report_size_threshold_config_and_logger(tmp_path, caplog):
+    """_report_size honors root.common.snapshot.report_size_threshold,
+    logs through the unit's logger (not bare print), and runs off the
+    training thread in async mode (it rides the writer job)."""
+    import logging
+    from veles_tpu.config import root
+    prior = root.common.snapshot.get("report_size_threshold", 64 << 20)
+    try:
+        root.common.snapshot.report_size_threshold = 1
+        wf = build(2, tmp_path, snap=True)
+        snap = wf.snapshotter
+        snap.skip = Bool(False)
+        with caplog.at_level(logging.WARNING, logger="SnapshotterToFile"):
+            snap.run()
+            assert snap.flush()
+        assert any("fattest units" in rec.message for rec in caplog.records)
+        # 0 disables the diagnostic entirely
+        caplog.clear()
+        root.common.snapshot.report_size_threshold = 0
+        with caplog.at_level(logging.WARNING, logger="SnapshotterToFile"):
+            snap.run()
+            assert snap.flush()
+        assert not any("fattest units" in rec.message
+                       for rec in caplog.records)
+    finally:
+        root.common.snapshot.report_size_threshold = prior

@@ -265,6 +265,19 @@ root.update({
             # 0 = exactly today's synchronous serving.
             "prefetch_depth": 2,
         },
+        "snapshot": {
+            # zero-stall checkpointing (snapshotter.py): capture on the
+            # training thread, pickle+compress+fsync+rename on a writer
+            # thread.  False = the exact old synchronous path (still
+            # atomic: tmp-write + rename).
+            "async_write": True,
+            # gz/bz2/xz codec level: 9 buys ~nothing on float weights
+            # and costs multiples in CPU time (bench.py snapshot stage)
+            "compression_level": 6,
+            # _report_size fattest-units diagnostic threshold, bytes
+            # (0 disables)
+            "report_size_threshold": 64 << 20,
+        },
         "trace": {"enabled": False, "file": None},
         "timings": set(),
         "random_seed": 1234,

@@ -63,6 +63,17 @@ def _find_loader(workflow):
     return None
 
 
+def _transient(method):
+    """Wrap a bound method in a plain function marked ``transient_`` so
+    ``Pickleable.__getstate__`` (and the snapshotter's deepcopy-based
+    capture) drops the instrumentation instead of dragging the profiler
+    — registry children, locks and all — into a snapshot."""
+    def call():
+        return method()
+    call.transient_ = True
+    return call
+
+
 class StepProfiler:
     """Wraps ``loader.run``/``step.run`` of one workflow with timing,
     recompile and memory accounting.  ``detach()`` restores both."""
@@ -99,6 +110,7 @@ class StepProfiler:
         self._h_data = self._h_phase.labels(phase="data_wait", **lbl)
         self._h_host = self._h_phase.labels(phase="host", **lbl)
         self._h_device = self._h_phase.labels(phase="device", **lbl)
+        self._h_snapshot = self._h_phase.labels(phase="snapshot", **lbl)
         self._g_rate = reg.gauge(
             "veles_training_examples_per_sec",
             "Sliding-window training throughput",
@@ -127,14 +139,27 @@ class StepProfiler:
         self._jit_cache = self._jit_cache_size()
         self._orig_step_run = step.run
         self._orig_loader_run = loader.run if loader is not None else None
-        # keep STABLE bound-method objects: attribute access creates a
-        # fresh bound method each time, so detach()'s identity check
-        # must compare against the exact object installed here
-        self._step_wrapper = self._step_run
-        self._loader_wrapper = self._loader_run_wrapped
+        # keep STABLE wrapper objects: detach()'s identity check must
+        # compare against the exact object installed here.  Transient
+        # plain-function closures, not bound methods — a snapshot taken
+        # with the profiler attached must drop the wrappers, not pickle
+        # the profiler (see _transient)
+        self._step_wrapper = _transient(self._step_run)
+        self._loader_wrapper = _transient(self._loader_run_wrapped)
         step.run = self._step_wrapper
         if loader is not None:
             loader.run = self._loader_wrapper
+        # snapshot capture stall as a distinct slice: wrap the
+        # snapshotter's run and attribute its measured export stall
+        self.snapshotter = getattr(workflow, "snapshotter", None) \
+            if workflow is not None else None
+        self.snapshot_s = 0.0
+        self._orig_snap_run = None
+        self._snap_wrapper = None
+        if self.snapshotter is not None:
+            self._orig_snap_run = self.snapshotter.run
+            self._snap_wrapper = _transient(self._snap_run)
+            self.snapshotter.run = self._snap_wrapper
 
     # -- instrumentation -----------------------------------------------------
     def _discover_jits(self):
@@ -239,6 +264,19 @@ class StepProfiler:
                     examples=n, recompiles=recompiled)
         return result
 
+    def _snap_run(self):
+        """The snapshotter accounts its own training-thread stall
+        (``stall_s``, zero for throttled-away calls) — read the delta so
+        a gating-only run never floods the phase histogram."""
+        snap = self.snapshotter
+        before = float(getattr(snap, "stall_s", 0.0) or 0.0)
+        result = self._orig_snap_run()
+        stalled = float(getattr(snap, "stall_s", 0.0) or 0.0) - before
+        if stalled > 0:
+            self.snapshot_s += stalled
+            self._h_snapshot.observe(stalled)
+        return result
+
     def _poll_memory(self):
         device = getattr(self.step, "device", None)
         for dev in getattr(device, "jax_devices", None) or []:
@@ -262,7 +300,9 @@ class StepProfiler:
         for obj, wrapper, orig in (
                 (self.step, self._step_wrapper, self._orig_step_run),
                 (self.loader, self._loader_wrapper,
-                 self._orig_loader_run)):
+                 self._orig_loader_run),
+                (self.snapshotter, self._snap_wrapper,
+                 self._orig_snap_run)):
             if obj is None:
                 continue
             if obj.__dict__.get("run") is wrapper:
@@ -284,12 +324,20 @@ class StepProfiler:
                "data_wait_s": round(self.data_wait_s, 4),
                "host_s": round(self.host_s, 4),
                "device_s": round(self.device_s, 4)}
+        if self.snapshot_s:
+            out["snapshot_stall_s"] = round(self.snapshot_s, 4)
         if total > 0:
             out["examples_per_sec"] = round(self.examples / total, 1)
             out["phase_pct"] = {
                 "data_wait": round(100 * self.data_wait_s / total, 1),
                 "host": round(100 * self.host_s / total, 1),
                 "device": round(100 * self.device_s / total, 1)}
+            if self.snapshot_s:
+                # share of the whole loop including checkpoint stalls —
+                # the slice async snapshotting exists to shrink
+                loop = total + self.snapshot_s
+                out["phase_pct"]["snapshot"] = round(
+                    100 * self.snapshot_s / loop, 1)
         if self.peak_memory:
             out["device_peak_memory_bytes"] = dict(self.peak_memory)
         prefetcher = getattr(self.loader, "prefetcher_", None)

@@ -32,7 +32,18 @@ class DistributedTrainStep(FusedTrainStep):
         self.model_axis = model_axis
         self.tp_mode = tp_mode
 
+    def __getstate__(self):
+        state = super().__getstate__()
+        mesh = state.get("mesh")
+        if mesh is not None and not isinstance(mesh, dict):
+            # Device handles are process-local: snapshot the GEOMETRY
+            # and rebuild over the restoring process's devices
+            state["mesh"] = mesh_mod.mesh_spec(mesh)
+        return state
+
     def initialize(self, device=None, **kwargs):
+        if isinstance(self.mesh, dict):   # restored from a snapshot
+            self.mesh = mesh_mod.make_mesh(self.mesh)
         super().initialize(device=device, **kwargs)
         import jax
         import numpy

@@ -40,6 +40,14 @@ def make_mesh(axes=None, devices=None):
     return Mesh(dev_array, tuple(names))
 
 
+def mesh_spec(mesh):
+    """Picklable ``{axis: size}`` geometry of a Mesh.  jax Device
+    handles are process-local and cannot be pickled — snapshots store
+    the spec and ``make_mesh(spec)`` rebuilds the mesh on the restoring
+    process's devices (the sharded steps do this in initialize)."""
+    return {name: int(size) for name, size in mesh.shape.items()}
+
+
 def register_mesh_metrics(mesh, workflow="-"):
     """Publish the mesh topology into the observability registry (one
     gauge series per axis) and stamp a ``mesh.initialized`` instant into

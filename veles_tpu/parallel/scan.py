@@ -37,6 +37,20 @@ class DistributedScanStep(ScanEpochStep):
         self.model_axis = model_axis
         self.tp_mode = tp_mode
 
+    def __getstate__(self):
+        state = super().__getstate__()
+        mesh = state.get("mesh")
+        if mesh is not None and not isinstance(mesh, dict):
+            # Device handles are process-local: snapshot the GEOMETRY
+            # and rebuild over the restoring process's devices
+            state["mesh"] = mesh_mod.mesh_spec(mesh)
+        return state
+
+    def initialize(self, device=None, **kwargs):
+        if isinstance(self.mesh, dict):   # restored from a snapshot
+            self.mesh = mesh_mod.make_mesh(self.mesh)
+        return super().initialize(device=device, **kwargs)
+
     # ScanEpochStep.initialize calls these AFTER the params/opt/macc and
     # the resident dataset exist, so the shardings can be computed and
     # the operands placed right here.

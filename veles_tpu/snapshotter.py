@@ -9,30 +9,68 @@ Snapshotter.import_file :522-535).  Device arrays are pulled to host by
 step's params/opt-state are synced into the forward units' Arrays first,
 so a snapshot of a fused workflow restores into either execution mode.
 
+Zero-stall asynchronous snapshotting (ISSUE 4): the reference shape —
+pickle + gzip + write inline in the unit graph — stalls the step loop
+for the whole durable write.  Here every shot is split into
+
+- a **capture phase** on the training thread: sync the fused step's
+  weights/solver state to host (the only part that must see a quiescent
+  step) and deep-copy the workflow's picklable state (the
+  ``Pickleable.__getstate__`` machinery — the same one pickle uses — so
+  ``transient_`` wrappers and ``_``-suffixed state are dropped
+  identically), then return to training immediately; and
+- a **durable-write phase** on a single writer thread
+  (:class:`SnapshotWriter`): pickle + compression + ``*.tmp`` write +
+  fsync + atomic ``os.rename`` + ``_current`` symlink flip (or the
+  serialized SQLite insert for :class:`SnapshotterToDB`).
+
+The writer queue coalesces periodic shots (drop-oldest — at most one
+periodic shot is ever pending) but never drops improvement shots; writer
+exceptions re-raise on the next :meth:`SnapshotterBase.run`; workflow
+finish flushes and joins the writer (no leaked threads, mirroring the
+prefetcher's lifecycle contract).  ``root.common.snapshot.async_write =
+False`` (or ``async_write=False`` per unit) restores the exact
+synchronous path — which is now atomic too: a kill mid-write can never
+leave ``_current`` pointing at a truncated file.  On multi-host runs
+only ``jax.process_index() == 0`` performs the write phase; the other
+processes keep identical throttle bookkeeping but never touch the
+(shared) filesystem.
+
 Suffix convention kept: the best metric value lands in the filename, e.g.
 ``mnist_validation_1.48.4.pickle.gz``.
 """
 
 import bz2
+import collections
+import copy
 import gzip
 import lzma
 import os
 import pickle
-import sys
+import threading
 import time
+import weakref
 
 from .config import root
+from .logger import Logger, events
 from .mutable import Bool
+from .observability.registry import REGISTRY
 from .registry import MappedObjectsRegistry, UnitRegistry
 from .result_provider import IResultProvider
 from .units import Unit
 
+#: compression → (fileobj, level) codec factory + filename extension.
+#: The level comes from ``root.common.snapshot.compression_level``
+#: (default 6: level 9 buys ~nothing on float weights and costs
+#: multiples in CPU time — measured by the ``snapshot`` bench stage).
 CODECS = {
-    None: (lambda f: f, ""),
-    "": (lambda f: f, ""),
-    "gz": (lambda f: gzip.GzipFile(fileobj=f, mode="wb"), ".gz"),
-    "bz2": (lambda f: bz2.BZ2File(f, "wb"), ".bz2"),
-    "xz": (lambda f: lzma.LZMAFile(f, "wb"), ".xz"),
+    None: (lambda f, lvl: f, ""),
+    "": (lambda f, lvl: f, ""),
+    "gz": (lambda f, lvl: gzip.GzipFile(fileobj=f, mode="wb",
+                                        compresslevel=lvl), ".gz"),
+    "bz2": (lambda f, lvl: bz2.BZ2File(f, "wb",
+                                       compresslevel=max(lvl, 1)), ".bz2"),
+    "xz": (lambda f, lvl: lzma.LZMAFile(f, "wb", preset=lvl), ".xz"),
 }
 
 DECODERS = {
@@ -42,12 +80,207 @@ DECODERS = {
     ".pickle": open,
 }
 
+#: how long blocked writer waits sleep before re-checking stop/failure
+_POLL_S = 0.05
+
+_is_writer_process = None
+_scalars_atomic = False
+
+
+def _register_atomic_scalars():
+    """Teach ``copy.deepcopy`` that numpy *number/bool scalars* are
+    immutable — shared into the copy like Python's int/str instead of
+    re-boxed one by one.  Loader label lists hold thousands of boxed
+    ``numpy.int32``; without this they dominate the capture walk
+    (measured: ~5 ms of an ~8 ms MNIST capture).  Registered via
+    ``setdefault`` (user overrides win) and only for scalar types that
+    really are immutable — ``numpy.void`` is item-assignable and stays
+    out."""
+    global _scalars_atomic
+    if _scalars_atomic:
+        return
+    try:
+        import numpy
+        atomic = copy._deepcopy_atomic
+        for t in set(numpy.sctypeDict.values()):
+            if isinstance(t, type) and \
+                    issubclass(t, (numpy.number, numpy.bool_)):
+                copy._deepcopy_dispatch.setdefault(t, atomic)
+    except Exception:  # noqa: BLE001 — an optimization, never a failure
+        pass
+    _scalars_atomic = True
+
+
+def _writer_process():
+    """True on the one process that materializes snapshots (multi-host:
+    ``jax.process_index() == 0``; everywhere else: always True)."""
+    global _is_writer_process
+    if _is_writer_process is None:
+        try:
+            import jax
+            _is_writer_process = jax.process_index() == 0
+        except Exception:  # noqa: BLE001 — no jax backend ⇒ standalone
+            _is_writer_process = True
+    return _is_writer_process
+
+
+class _WriteJob:
+    __slots__ = ("fn", "improved", "label")
+
+    def __init__(self, fn, improved, label):
+        self.fn = fn
+        self.improved = improved
+        self.label = label
+
+
+def _writer_main(ref, stop_evt):
+    """Writer thread entry.  Holds only a WEAK reference between jobs
+    (same rationale as the prefetcher's worker): an abandoned
+    snapshotter must stay garbage-collectable."""
+    while True:
+        self = ref()
+        if self is None:
+            return
+        if not self._work_once():
+            del self
+            if stop_evt.wait(_POLL_S):
+                return
+
+
+class SnapshotWriter:
+    """Single background thread owning the durable-write phase.
+
+    The queue is effectively depth-1: a newly submitted *periodic* shot
+    replaces any still-pending periodic shot (drop-oldest coalescing —
+    the newest weights are strictly more useful than stale ones), while
+    *improvement* shots are never dropped (they are edge-triggered, at
+    most one per validation epoch, so the queue stays tiny).  A job
+    exception parks the writer and is re-delivered via
+    :meth:`take_failure` (the snapshotter raises it on its next run);
+    the un-failed remainder of the queue is retried when the writer
+    restarts on the next submit.
+    """
+
+    def __init__(self, name="snapshot", registry=None):
+        self.name = name
+        self._jobs = collections.deque()
+        self._lock = threading.Lock()
+        self._busy = False
+        self._thread = None
+        self._stop_evt = threading.Event()
+        self._failure = None
+        self.written = 0
+        self.coalesced = 0
+        reg = registry or REGISTRY
+        lbl = {"snapshotter": name}
+        self._g_queue = reg.gauge(
+            "veles_snapshot_writer_queue",
+            "Snapshot write jobs queued behind the writer thread",
+            ("snapshotter",)).labels(**lbl)
+        self._c_coalesced = reg.counter(
+            "veles_snapshot_coalesced_total",
+            "Periodic snapshots dropped by drop-oldest queue coalescing",
+            ("snapshotter",)).labels(**lbl)
+
+    # -- producer side (training thread) -------------------------------------
+    def submit(self, fn, improved=False, label=None):
+        """Enqueue one durable-write job and return immediately."""
+        with self._lock:
+            if not improved:
+                for i in range(len(self._jobs)):
+                    if not self._jobs[i].improved:
+                        del self._jobs[i]
+                        self.coalesced += 1
+                        self._c_coalesced.inc()
+                        break
+            self._jobs.append(_WriteJob(fn, improved, label))
+            self._g_queue.set(len(self._jobs))
+            self._ensure_thread()
+
+    def _ensure_thread(self):
+        # caller holds self._lock
+        t = self._thread
+        if t is not None and t.is_alive():
+            return
+        if self._failure is not None:
+            return  # parked until take_failure() delivers the exception
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(
+            target=_writer_main,
+            args=(weakref.ref(self), self._stop_evt), daemon=True,
+            name="veles-snapwriter-%s" % self.name)
+        self._thread.start()
+
+    # -- consumer side (writer thread) ---------------------------------------
+    def _work_once(self):
+        """Run one queued job; returns False when the queue was empty."""
+        with self._lock:
+            if not self._jobs:
+                return False
+            job = self._jobs.popleft()
+            self._busy = True
+            self._g_queue.set(len(self._jobs))
+        try:
+            job.fn()
+        except BaseException as exc:  # noqa: BLE001 — re-raised at run()
+            with self._lock:
+                self._failure = exc
+                self._busy = False
+            return False
+        with self._lock:
+            self._busy = False
+            self.written += 1
+        return True
+
+    # -- lifecycle -----------------------------------------------------------
+    def flush(self, timeout=60.0):
+        """Block until every queued job is durably done (True) or the
+        writer failed / the timeout expired (False)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._failure is not None:
+                    return False
+                if not self._jobs and not self._busy:
+                    return True
+                self._ensure_thread()
+            time.sleep(0.01)
+        return False
+
+    def stop(self, timeout=60.0):
+        """Flush then join the thread (workflow finish / detach); the
+        writer restarts lazily on the next submit."""
+        ok = self.flush(timeout)
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10)
+        with self._lock:
+            self._thread = None
+        return ok
+
+    def take_failure(self):
+        """Pop the stored writer exception (or None); popping un-parks
+        the writer so the queue remainder is retried on the next
+        submit."""
+        with self._lock:
+            exc, self._failure = self._failure, None
+            return exc
+
+    def stats(self):
+        with self._lock:
+            return {"written": self.written,
+                    "coalesced": self.coalesced,
+                    "queued": len(self._jobs),
+                    "busy": self._busy}
+
 
 class SnapshotterRegistry(UnitRegistry, MappedObjectsRegistry):
     """Units that are also a string-keyed family ("file", "db", ...)."""
 
 
-class SnapshotterBase(Unit, IResultProvider, metaclass=SnapshotterRegistry):
+class SnapshotterBase(Unit, IResultProvider, Logger,
+                      metaclass=SnapshotterRegistry):
     """Base: throttling + gate protocol (runs when Decision.improved)."""
 
     mapping = "snapshotter"
@@ -60,13 +293,25 @@ class SnapshotterBase(Unit, IResultProvider, metaclass=SnapshotterRegistry):
         self.interval = kwargs.get("interval", 1)     # epochs between shots
         self.time_interval = kwargs.get("time_interval", 15)  # seconds
         self.compression = kwargs.get("compression", "gz")
+        # None = follow the root.common.snapshot.* config defaults
+        self.async_write = kwargs.get("async_write")
+        self.compression_level = kwargs.get("compression_level")
+        self.report_size_threshold = kwargs.get("report_size_threshold")
         self.suffix = None
         self.destination = None
         self.skip = Bool(False)
         self.decision = None
-        self._last_time = 0.0
         self._counter = 0
         self._last_exported_best = None
+        self.stall_s = 0.0        # cumulative training-thread stall
+        self.last_stall_s = 0.0
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        # monotonic-clock bookkeeping: a value pickled in another
+        # process/boot is meaningless here — reset so the first shot
+        # after a restore is never spuriously throttled
+        self._last_time_ = None
 
     def link_decision(self, decision):
         """Wire a Decision so improved-model snapshots carry the best
@@ -103,17 +348,88 @@ class SnapshotterBase(Unit, IResultProvider, metaclass=SnapshotterRegistry):
             return False
         return self._decision_best() != self._last_exported_best
 
+    # -- config-or-kwarg knobs ----------------------------------------------
+    def _async_enabled(self):
+        v = self.async_write
+        if v is None:
+            v = root.common.snapshot.get("async_write", True)
+        return bool(v)
+
+    def _compression_level(self):
+        lvl = self.compression_level
+        if lvl is None:
+            lvl = root.common.snapshot.get("compression_level", 6)
+        return max(0, min(9, int(lvl)))
+
+    # -- writer / metrics plumbing (transient — recreated lazily) ------------
+    def _get_writer(self):
+        w = getattr(self, "_writer_", None)
+        if w is None:
+            w = self._writer_ = SnapshotWriter(name=self.prefix)
+        return w
+
+    def _obs(self):
+        m = getattr(self, "_obs_", None)
+        if m is None:
+            lbl = {"snapshotter": self.prefix}
+            m = self._obs_ = {
+                "stall": REGISTRY.counter(
+                    "veles_snapshot_stall_seconds_total",
+                    "Training-thread seconds stalled per snapshot "
+                    "(capture + submit; the full write when synchronous)",
+                    ("snapshotter",)).labels(**lbl),
+                "bytes": REGISTRY.counter(
+                    "veles_snapshot_bytes_written_total",
+                    "Snapshot bytes durably written",
+                    ("snapshotter",)).labels(**lbl),
+                "written": REGISTRY.counter(
+                    "veles_snapshots_written_total",
+                    "Snapshots durably written",
+                    ("snapshotter",)).labels(**lbl),
+            }
+        return m
+
+    def _capture(self, target):
+        """Capture phase: deep-copy the workflow's picklable state on
+        the training thread.  ``copy.deepcopy`` routes through the same
+        ``Pickleable.__getstate__`` machinery as pickle itself — the
+        ``transient_`` instrumentation wrappers (prefetcher/profiler)
+        and ``_``-suffixed state are dropped identically, and Arrays
+        pull device values to host — so the writer thread serializes a
+        frozen, race-free twin while training mutates the original.
+        Returns None (→ synchronous fallback) when the copy fails."""
+        _register_atomic_scalars()
+        t0 = time.perf_counter()
+        try:
+            snapshot = copy.deepcopy(target)
+        except Exception as exc:  # noqa: BLE001 — fall back, never lose a shot
+            self.warning(
+                "snapshot capture failed (%s: %s); falling back to a "
+                "synchronous write", type(exc).__name__, exc)
+            return None
+        events.span("snapshot.capture", time.perf_counter() - t0,
+                    snapshotter=self.prefix)
+        return snapshot
+
     def run(self):
+        w = getattr(self, "_writer_", None)
+        if w is not None:
+            exc = w.take_failure()
+            if exc is not None:
+                raise exc
         if bool(self.skip):
             return
         self._counter += 1
         if self._counter % max(self.interval, 1):
             return
         fresh = self._fresh_improvement()
-        if not fresh and \
-                time.time() - self._last_time < self.time_interval:
+        # monotonic, not time.time(): an NTP step / wall-clock jump must
+        # never suppress (or force) a shot (same fix as the EventLog)
+        last = self._last_time_
+        if not fresh and last is not None and \
+                time.monotonic() - last < self.time_interval:
             return
-        self._last_time = time.time()
+        self._last_time_ = time.monotonic()
         if fresh:
             # the suffix names the metric these weights actually achieved;
             # non-improved periodic shots keep the previous suffix only if
@@ -122,10 +438,46 @@ class SnapshotterBase(Unit, IResultProvider, metaclass=SnapshotterRegistry):
             self._last_exported_best = self._decision_best()
         elif self.decision is not None:
             self.suffix = None
-        self.export()
+        if not _writer_process():
+            # multi-host: process 0 owns the (shared) filesystem; the
+            # others keep identical throttle state but skip the write
+            # phase entirely instead of racing on it
+            return
+        self._exporting_improvement_ = fresh
+        t0 = time.perf_counter()
+        try:
+            self.export()
+        finally:
+            self._exporting_improvement_ = False
+            stall = time.perf_counter() - t0
+            self.last_stall_s = stall
+            self.stall_s += stall
+            self._obs()["stall"].inc(stall)
 
     def export(self):
         raise NotImplementedError
+
+    # -- lifecycle -----------------------------------------------------------
+    def flush(self, timeout=60.0):
+        """Block until every queued snapshot is durably written."""
+        w = getattr(self, "_writer_", None)
+        return w.flush(timeout) if w is not None else True
+
+    def stop(self):
+        """Workflow finished: flush + join the writer so no thread (and
+        no buffered shot) outlives ``Workflow.run()``."""
+        w = getattr(self, "_writer_", None)
+        if w is None:
+            return
+        if not w.stop():
+            # finish-time failures can't surface on a next run() that
+            # may never come — at least say so loudly
+            self.error("snapshot writer did not drain cleanly at "
+                       "workflow finish: %s", w.stats())
+
+    def writer_stats(self):
+        w = getattr(self, "_writer_", None)
+        return w.stats() if w is not None else None
 
     def get_metric_values(self):
         """Surface the last snapshot path in the results JSON (reference
@@ -150,49 +502,103 @@ class SnapshotterToFile(SnapshotterBase):
         target = self.workflow
         fused = getattr(target, "fused_step", None)
         if fused is not None:
+            # the only part that must see a quiescent step: pull the
+            # fused params/opt-state back into the units' host Arrays
             fused.sync_weights()
             fused.sync_solver_state()
         name = "%s%s.%d.pickle" % (
             self.prefix, ("_" + self.suffix) if self.suffix else "",
             self._counter)
-        codec, ext = CODECS[self.compression or None]
-        path = os.path.join(self.directory, name + ext)
-        with open(path, "wb") as raw:
-            stream = codec(raw)
+        path = os.path.join(
+            self.directory, name + CODECS[self.compression or None][1])
+        payload = self._capture(target) if self._async_enabled() else None
+        if payload is None:
+            self._write_file(target, path)
+        else:
+            self._get_writer().submit(
+                lambda: self._write_file(payload, path),
+                improved=bool(getattr(self, "_exporting_improvement_",
+                                      False)),
+                label=name)
+        self.destination = path
+        return path
+
+    def _write_file(self, obj, path):
+        """Durable-write phase (writer thread; inline when synchronous):
+        pickle+compress into ``<path>.tmp``, fsync, atomically rename,
+        then flip the ``_current`` symlink — a kill at ANY point leaves
+        either the old snapshot set intact or the new file complete,
+        never a truncated file at its final name."""
+        t0 = time.perf_counter()
+        codec, _ = CODECS[self.compression or None]
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as raw:
+            stream = codec(raw, self._compression_level())
             try:
-                pickle.dump(target, stream,
+                pickle.dump(obj, stream,
                             protocol=pickle.HIGHEST_PROTOCOL)
             finally:
                 if stream is not raw:
                     stream.close()
-        self.destination = path
-        link = os.path.join(self.directory, "%s_current" % self.prefix)
-        try:
-            if os.path.islink(link):
-                os.remove(link)
-            os.symlink(os.path.basename(path), link)
-        except OSError:
-            pass
-        self._report_size(path, target)
+            raw.flush()
+            os.fsync(raw.fileno())
+        os.replace(tmp, path)
+        self._fsync_dir()
+        self._flip_current(path)
+        size = os.path.getsize(path)
+        obs = self._obs()
+        obs["bytes"].inc(size)
+        obs["written"].inc()
+        events.span("snapshot.write", time.perf_counter() - t0,
+                    snapshotter=self.prefix, path=path, bytes=size)
+        self._report_size(path, size, obj)
         return path
 
-    def _report_size(self, path, workflow, top=5):
+    def _fsync_dir(self):
+        try:
+            fd = os.open(self.directory, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+
+    def _flip_current(self, path):
+        """Atomically repoint ``<prefix>_current``: build the new
+        symlink beside it and rename over — readers never observe a
+        missing or dangling link."""
+        link = os.path.join(self.directory, "%s_current" % self.prefix)
+        tmp_link = link + ".tmp"
+        try:
+            if os.path.lexists(tmp_link):
+                os.remove(tmp_link)
+            os.symlink(os.path.basename(path), tmp_link)
+            os.replace(tmp_link, link)
+        except OSError:
+            pass
+
+    def _report_size(self, path, size, workflow, top=5):
         """Top-N fattest units diagnostic (reference snapshotter.py:
-        203-226)."""
-        size = os.path.getsize(path)
-        if size < 64 << 20:
+        203-226).  Runs on the writer thread in async mode — the
+        per-unit re-pickle never stalls the step loop."""
+        threshold = self.report_size_threshold
+        if threshold is None:
+            threshold = root.common.snapshot.get(
+                "report_size_threshold", 64 << 20)
+        threshold = int(threshold)
+        if threshold <= 0 or size < threshold:
             return
         sizes = []
         for unit in workflow:
             try:
                 sizes.append((len(pickle.dumps(unit, -1)), unit.name))
-            except Exception:
+            except Exception:  # noqa: BLE001 — diagnostics never raise
                 pass
-        print("snapshot %s is %.1f MiB; fattest units:" %
-              (path, size / 1048576), file=sys.stderr)
-        for sz, name in sorted(sizes, reverse=True)[:top]:
-            print("  %-30s %.1f MiB" % (name, sz / 1048576),
-                  file=sys.stderr)
+        lines = ["  %-30s %.1f MiB" % (name, sz / 1048576)
+                 for sz, name in sorted(sizes, reverse=True)[:top]]
+        self.warning("snapshot %s is %.1f MiB; fattest units:\n%s",
+                     path, size / 1048576, "\n".join(lines))
 
     @staticmethod
     def import_file(path):
@@ -210,7 +616,9 @@ class SnapshotterToFile(SnapshotterBase):
 class SnapshotterToDB(SnapshotterBase):
     """Snapshots into a SQLite database (reference SnapshotterToDB,
     snapshotter.py:428-520, used ODBC; SQLite is the zero-dependency
-    equivalent — same pickle blobs, queryable history, single file)."""
+    equivalent — same pickle blobs, queryable history, single file).
+    Async mode uses the same single writer thread as the file path, so
+    database access is naturally serialized."""
 
     MAPPING = "db"
 
@@ -226,7 +634,6 @@ class SnapshotterToDB(SnapshotterBase):
             "snapshots.sqlite3")
 
     def export(self):
-        import sqlite3
         os.makedirs(os.path.dirname(os.path.abspath(self.database)),
                     exist_ok=True)
         target = self.workflow
@@ -234,16 +641,37 @@ class SnapshotterToDB(SnapshotterBase):
         if fused is not None:
             fused.sync_weights()
             fused.sync_solver_state()
-        blob = pickle.dumps(target, protocol=pickle.HIGHEST_PROTOCOL)
+        # wall clock ON PURPOSE: a queryable history column, not
+        # throttle bookkeeping
+        row = (self.prefix, self.suffix, self._counter, time.time())
+        payload = self._capture(target) if self._async_enabled() else None
+        if payload is None:
+            self._write_db(target, row)
+        else:
+            self._get_writer().submit(
+                lambda: self._write_db(payload, row),
+                improved=bool(getattr(self, "_exporting_improvement_",
+                                      False)),
+                label="%s.%d" % (self.prefix, self._counter))
+        self.destination = "sqlite://%s#%s" % (self.database, self.prefix)
+        return self.destination
+
+    def _write_db(self, obj, row):
+        import sqlite3
+        t0 = time.perf_counter()
+        blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
         with sqlite3.connect(self.database) as conn:
             conn.execute(self.SCHEMA)
             conn.execute(
                 "INSERT INTO snapshots (prefix, suffix, counter, "
                 "timestamp, blob) VALUES (?, ?, ?, ?, ?)",
-                (self.prefix, self.suffix, self._counter, time.time(),
-                 sqlite3.Binary(blob)))
-        self.destination = "sqlite://%s#%s" % (self.database, self.prefix)
-        return self.destination
+                row + (sqlite3.Binary(blob),))
+        obs = self._obs()
+        obs["bytes"].inc(len(blob))
+        obs["written"].inc()
+        events.span("snapshot.write", time.perf_counter() - t0,
+                    snapshotter=self.prefix, database=self.database,
+                    bytes=len(blob))
 
     @staticmethod
     def import_db(uri):

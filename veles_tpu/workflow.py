@@ -291,14 +291,21 @@ class Workflow(Container):
     def attach_profiler(self, **kwargs):
         """Instrument this workflow's training step with a
         :class:`~veles_tpu.observability.profiler.StepProfiler`
-        (data-wait/host/device split, recompile count, examples/sec,
-        memory watermarks → registry metrics + EventLog spans).  Call
-        after ``initialize`` — the step's jitted functions must exist
-        for recompile accounting.  The profiler is also reachable as
-        ``self.profiler``; ``profiler.detach()`` removes it."""
+        (data-wait/host/device/snapshot split, recompile count,
+        examples/sec, memory watermarks → registry metrics + EventLog
+        spans).  Call after ``initialize`` — the step's jitted functions
+        must exist for recompile accounting.  The profiler is also
+        reachable as ``self.profiler``; ``profiler.detach()`` removes
+        its wrappers.  Stored transiently (``profiler_``): a snapshot
+        taken while profiling must never try to serialize the profiler
+        (registry series hold locks)."""
         from .observability.profiler import StepProfiler
-        self.profiler = StepProfiler(self, **kwargs)
-        return self.profiler
+        self.profiler_ = StepProfiler(self, **kwargs)
+        return self.profiler_
+
+    @property
+    def profiler(self):
+        return getattr(self, "profiler_", None)
 
     # -- results / stats -----------------------------------------------------
     def gather_results(self):

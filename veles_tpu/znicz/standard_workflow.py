@@ -345,7 +345,21 @@ class StandardWorkflow(Workflow):
         self.snapshotter.link_from(tail)
         return self.snapshotter
 
+    def __getstate__(self):
+        state = super().__getstate__()
+        mesh = state.get("mesh")
+        if mesh is not None and not isinstance(mesh, dict):
+            # jax Device handles are process-local; snapshot the axis
+            # geometry instead (the sharded steps do the same) and
+            # rebuild over the restoring process's devices
+            from ..parallel import mesh as mesh_mod
+            state["mesh"] = mesh_mod.mesh_spec(mesh)
+        return state
+
     def initialize(self, device=None, **kwargs):
+        if isinstance(self.mesh, dict):   # restored from a snapshot
+            from ..parallel import mesh as mesh_mod
+            self.mesh = mesh_mod.make_mesh(self.mesh)
         if self.restored_from_snapshot:
             self._relink_gates()
         result = super().initialize(device=device, **kwargs)
