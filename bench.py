@@ -622,7 +622,71 @@ def bench_serving(clients=8, seconds=2.0):
             "serve_p99_ms": out.get("serve_p99_ms"),
             "serve_batch_fill": out.get("batch_fill"),
             "serve_post_warmup_compiles":
-                out.get("post_warmup_compiles")}
+                out.get("post_warmup_compiles"),
+            "serve_time_to_first_response_s":
+                out.get("serve_time_to_first_response_s")}
+
+
+def bench_cold_start(max_batch=16, probe_timeout=150):
+    """Process-start -> first-inference / first-train-step with the
+    persistent executable cache (veles_tpu.compilecache) off, cold and
+    warm (ISSUE 5 acceptance: the second start's serving warmup path
+    >= 2x faster cache-on vs cache-off).  Each probe is a FRESH
+    subprocess (tools/cold_start.py) — compilation caches only matter
+    across process lifetimes, so in-process timing would be fiction."""
+    import subprocess
+    import tempfile
+    _stamp("cold-start stage: building package")
+    from tools.serve_bench import build_mnist_package
+    tmp = tempfile.mkdtemp(prefix="veles-cold-start-")
+    package = build_mnist_package(os.path.join(tmp, "mnist_pkg.zip"))
+    cache_dir = os.path.join(tmp, "compile_cache")
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "cold_start.py")
+
+    def probe(phase, cached):
+        argv = [sys.executable, tool, "--phase", phase,
+                "--max-batch", str(max_batch)]
+        if phase == "serving":
+            argv += ["--package", package]
+        if cached:
+            argv += ["--cache-dir", cache_dir]
+        proc = subprocess.run(argv, capture_output=True,
+                              timeout=probe_timeout)
+        line = _last_json_line(proc.stdout.decode())
+        if line is None:
+            raise RuntimeError("cold_start probe %s/%s failed: %s"
+                               % (phase, cached,
+                                  proc.stderr.decode()[-400:]))
+        _stamp("cold-start %s cached=%s: total %.2fs warmup %.2fs"
+               % (phase, cached, line.get("total_s", -1),
+                  line.get("warmup_s") or line.get("first_step_s", -1)))
+        return line
+
+    out = {}
+    serve_off = probe("serving", False)
+    serve_cold = probe("serving", True)     # populates the cache
+    serve_warm = probe("serving", True)     # the restart being measured
+    out["cold_start_serving_off_warmup_s"] = serve_off["warmup_s"]
+    out["cold_start_serving_cold_warmup_s"] = serve_cold["warmup_s"]
+    out["cold_start_serving_warm_warmup_s"] = serve_warm["warmup_s"]
+    out["cold_start_serving_off_total_s"] = serve_off["total_s"]
+    out["cold_start_serving_warm_total_s"] = serve_warm["total_s"]
+    out["cold_start_serving_warm_compiles"] = serve_warm["compiles"]
+    out["cold_start_serving_warm_cache_hits"] = serve_warm["cache_hits"]
+    if serve_warm["warmup_s"]:
+        out["cold_start_serving_warmup_speedup"] = round(
+            serve_off["warmup_s"] / serve_warm["warmup_s"], 2)
+    train_off = probe("train", False)
+    probe("train", True)                    # populate
+    train_warm = probe("train", True)
+    out["cold_start_train_off_first_step_s"] = train_off["first_step_s"]
+    out["cold_start_train_warm_first_step_s"] = \
+        train_warm["first_step_s"]
+    if train_warm["first_step_s"]:
+        out["cold_start_train_first_step_speedup"] = round(
+            train_off["first_step_s"] / train_warm["first_step_s"], 2)
+    return out
 
 
 def bench_observability(batch=512, steps=64, repeats=5):
@@ -857,6 +921,8 @@ def _stage_main(stage):
         out = bench_observability()
     elif stage == "snapshot":
         out = bench_snapshot()
+    elif stage == "cold_start":
+        out = bench_cold_start()
     else:
         raise SystemExit("unknown stage %r" % stage)
     out["spread"] = SPREAD
@@ -900,6 +966,11 @@ STAGE_PLAN = [
     # per-snapshot step-loop stall, sync vs async write + the gz9->gz6
     # compression-level delta (ISSUE 4 acceptance: stall >= 5x)
     ("snapshot", 300),
+    # process-restart cost with the persistent executable cache off /
+    # cold / warm (ISSUE 5 acceptance: warm serving warmup >= 2x) —
+    # six fresh subprocesses, each its own import+compile, so this
+    # stage needs real wall clock despite doing almost no device work
+    ("cold_start", 420),
 ]
 
 

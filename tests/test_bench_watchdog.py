@@ -89,6 +89,10 @@ def test_stage_plan_is_headline_first():
     assert order[1] == "alexnet_f32"
     assert order.index("alexnet_bf16") < order.index("pallas_lrn")
     assert order.index("alexnet_f32") < order.index("precise_gemm")
+    # the cold-start stage (ISSUE 5) rides in the optional tail with
+    # its own timeout budget, behind every headline training stage
+    assert "cold_start" in order
+    assert order.index("cold_start") > order.index("mnist")
 
 
 def test_last_json_line_recovers_partial_output():

@@ -76,6 +76,40 @@ def test_stablehlo_round_trip(trained_mnist):
     assert numpy.abs(out1 - live[:1]).max() < 1e-6
 
 
+def test_deserialize_is_thread_safe(trained_mnist, monkeypatch):
+    """Two concurrent FIRST requests must not both deserialize and race
+    ``_exported`` (ISSUE 5 satellite): exactly one jax.export
+    deserialization happens, the loser reuses the winner's."""
+    import threading
+    from jax import export as jexport
+    pkg = PackageLoader(trained_mnist[1])
+    calls = []
+    barrier = threading.Barrier(2)
+    real = jexport.deserialize
+
+    def slow_deserialize(artifact):
+        calls.append(threading.get_ident())
+        import time
+        time.sleep(0.05)            # widen the race window
+        return real(artifact)
+
+    monkeypatch.setattr(jexport, "deserialize", slow_deserialize)
+    results = {}
+
+    def first_request(i):
+        barrier.wait()
+        results[i] = pkg.deserialize()
+
+    threads = [threading.Thread(target=first_request, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert len(calls) == 1          # one deserialize, not two
+    assert results[0] is results[1] is pkg._exported
+
+
 def test_fp16_package_loads(trained_mnist, tmp_path):
     wf, _path, x, live = trained_mnist
     path = str(tmp_path / "fp16.zip")

@@ -256,9 +256,18 @@ def run_bench(package=None, clients=8, seconds=2.0, sizes=DEFAULT_SIZES,
     # the scheduler's request interface (submit → batched executable)
     seed_infer = lambda x: numpy.asarray(loader.run(x))  # noqa: E731
     seed_infer(numpy.zeros((1,) + sample_shape, numpy.float32))  # warm
+    # time-to-first-response: scheduler construction (bucket-ladder
+    # warmup — compiles, or deserializes off a warm executable cache)
+    # through the first answered request; the cold-start regression
+    # signal in every BENCH_*.json (bench.py cold_start stage measures
+    # the same path across fresh processes)
+    t0 = time.perf_counter()
     scheduler = BucketScheduler(loader, max_batch=max_batch,
                                 queue_limit=max(4 * clients, 64),
                                 name="serve_bench")
+    scheduler.infer(numpy.zeros((1,) + sample_shape, numpy.float32))
+    out["serve_time_to_first_response_s"] = round(
+        time.perf_counter() - t0, 4)
     assert max(sizes) <= max_batch, "request sizes must fit max_batch"
     sched_infer = lambda x: scheduler.submit(x).result()  # noqa: E731
     try:

@@ -410,6 +410,15 @@ class Main:
         train-side flags don't apply; ``--backend`` still picks the
         JAX platform the executables compile for."""
         args = self.args
+        # with --serve there is no workflow module, so positional args
+        # shift: `root.x=v` strings (and a config file) slide from the
+        # workflow/config slots into the override list
+        for slot in ("config", "workflow"):
+            value = getattr(args, slot)
+            if value is not None and "=" in value \
+                    and not os.path.exists(value):
+                args.overrides.insert(0, value)
+                setattr(args, slot, None)
         if args.workflow:
             raise SystemExit("--serve serves exported packages; drop "
                              "the workflow argument (train first, "
@@ -418,6 +427,17 @@ class Main:
         if args.backend and args.backend not in ("auto", "numpy"):
             import jax
             jax.config.update("jax_platforms", args.backend)
+        # config overrides apply in serve mode too — that's how the
+        # compile cache is pointed at its directory from the CLI
+        # (`root.common.compile_cache={'dir': ...}`); a config file in
+        # the shifted positional slot applies first, overrides on top
+        if args.config:
+            apply_config_file(args.config)
+        for override in args.overrides:
+            path, _, value = override.partition("=")
+            if not value:
+                raise SystemExit("override %r needs =value" % override)
+            set_config_by_path(root, path, _parse_value(value))
         from .serving import InferenceServer
         models = []
         for spec in args.serve:

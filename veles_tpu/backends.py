@@ -25,6 +25,30 @@ import numpy
 from .config import root
 
 
+def apply_compilation_cache_config():
+    """One-knob wiring of JAX's built-in persistent compilation cache:
+    ``root.common.engine.compilation_cache_dir`` (+ min-entry-size)
+    applied at backend init — every ``jax.jit`` in the process then
+    reuses XLA binaries across restarts, covering what the executable
+    cache (veles_tpu/compilecache/) doesn't own.  Unset = untouched
+    (exact default behavior).  Returns the directory applied or None."""
+    directory = root.common.engine.get("compilation_cache_dir", None)
+    if not directory:
+        return None
+    import jax
+    directory = os.path.abspath(str(directory))
+    os.makedirs(directory, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", directory)
+    jax.config.update(
+        "jax_persistent_cache_min_entry_size_bytes",
+        int(root.common.engine.get("compilation_cache_min_entry_bytes",
+                                   0)))
+    # the default 1 s floor would skip every small-model compile this
+    # knob exists to persist; the entry-size knob is the filter here
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return directory
+
+
 class BackendRegistry(type):
     """Metaclass registering Device subclasses by their ``BACKEND`` name
     (reference backends.py:166-181)."""
@@ -84,6 +108,7 @@ class Device(metaclass=BackendRegistry):
         # precision, or every later workflow silently pays 3-6x matmuls
         jax.config.update("jax_default_matmul_precision",
                           self.PRECISION_LEVELS[level])
+        apply_compilation_cache_config()
 
     # Devices ride along in workflow snapshots only as stubs: locks and
     # PJRT handles cannot pickle, and a restored workflow is re-attached

@@ -1,0 +1,38 @@
+"""Persistent compiled-executable cache + warmup manifests.
+
+Every process (re)start used to pay full XLA compilation from scratch:
+the serving scheduler AOT-compiles ``log2(max_batch)+1`` bucket
+executables per model at startup, and the fused training step re-jits
+after every :class:`~veles_tpu.distributed.ElasticRunner` respawn or
+snapshot restore.  This package makes compiled executables survive the
+process (the ahead-of-time-compiled serving posture TVM argues for,
+PAPERS.md, extended across process lifetimes):
+
+- :mod:`.keys` — fingerprint a lowering into a cache key (StableHLO
+  text + jax/jaxlib versions + backend platform + device kind/count +
+  caller extras), so a stale entry *misses* instead of misloading;
+- :mod:`.store` — content-addressed on-disk store (tmp + fsync +
+  atomic rename, the snapshotter's durability conventions), with a
+  size-budget LRU sweep and quarantine-on-corrupt;
+- :mod:`.cache` — :class:`CompileCache.get_or_compile` wrapping
+  ``jit -> lower -> compile`` with
+  ``jax.experimental.serialize_executable``, plus :class:`AotStep`,
+  the first-call AOT wrapper the fused train step uses;
+- :mod:`.manifest` — :class:`WarmupManifest`: serving records every
+  (model, bucket) actually compiled; on restart the scheduler
+  precompiles from the manifest through the cache.
+
+Config: ``root.common.compile_cache.{dir, enabled, max_bytes,
+background_warmup}`` (or ``$VELES_COMPILE_CACHE_DIR``).  Default on
+when a dir is set; unset dir = exact pre-cache behavior.
+"""
+
+from .cache import (AotStep, CompileCache, default_cache, inject_env,
+                    reset_default_caches, resolve_config)
+from .keys import cache_key, environment_fingerprint
+from .manifest import WarmupManifest
+from .store import ExecutableStore
+
+__all__ = ["AotStep", "CompileCache", "ExecutableStore", "WarmupManifest",
+           "cache_key", "default_cache", "environment_fingerprint",
+           "inject_env", "reset_default_caches", "resolve_config"]

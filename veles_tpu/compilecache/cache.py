@@ -1,0 +1,315 @@
+"""get_or_compile: the jit -> lower -> compile wrap with persistence.
+
+:class:`CompileCache` sits between a ``jax.jit`` function and XLA: the
+lowering is fingerprinted (:mod:`.keys`), looked up in the on-disk
+store (:mod:`.store`), and either **deserialized** back into a loaded
+executable (``jax.experimental.serialize_executable`` — milliseconds)
+or **compiled** fresh and persisted for the next process.  Every
+outcome is observable: ``veles_compile_cache_{hits,misses,bytes,
+seconds_saved}_total`` in the process-global MetricsRegistry and
+``compile.cache_hit`` / ``compile.miss`` trace spans.
+
+Failure policy — the cache may only ever cost a recompile, never a
+crash or a wrong result: a truncated/undeserializable entry is
+quarantined (renamed aside) and the caller falls back to a fresh
+compile; a full disk loses the *persist*, not the compile; any
+environment drift (jax/jaxlib version, platform, device kind) changes
+the key and misses cleanly.
+
+:class:`AotStep` is the training-side adapter: a first-call AOT wrapper
+around a jitted step function that lowers against the concrete call's
+shapes, runs ``get_or_compile``, and executes the loaded executable
+thereafter — with a one-way fallback to the plain jit path on ANY
+surprise, so enabling the cache can never change training results.
+"""
+
+import logging
+import os
+import pickle
+import time
+
+from ..config import root
+from ..logger import events
+from ..observability.registry import REGISTRY
+from .keys import cache_key
+from .manifest import WarmupManifest
+from .store import ExecutableStore
+
+log = logging.getLogger("veles_tpu.compilecache")
+
+#: env var a supervisor (ElasticRunner) uses to hand the cache dir to
+#: respawned children that don't re-read its programmatic config
+CACHE_DIR_ENV = "VELES_COMPILE_CACHE_DIR"
+MAX_BYTES_ENV = "VELES_COMPILE_CACHE_MAX_BYTES"
+
+#: store blob format version — bump on layout change (old entries then
+#: quarantine-and-recompile once, which is the upgrade path)
+_FORMAT = 1
+
+
+class CompileCache:
+    """Persistent executable cache over one directory."""
+
+    def __init__(self, directory, max_bytes=None, registry=None):
+        registry = registry or REGISTRY
+        self.store = ExecutableStore(directory, max_bytes=max_bytes)
+        self.manifest = WarmupManifest(
+            os.path.join(self.store.directory, "warmup_manifest.json"))
+        self._c_hits = registry.counter(
+            "veles_compile_cache_hits_total",
+            "Executable cache hits (deserialize instead of compile)")
+        self._c_misses = registry.counter(
+            "veles_compile_cache_misses_total",
+            "Executable cache misses (fresh XLA compile)")
+        self._c_bytes = registry.counter(
+            "veles_compile_cache_bytes_total",
+            "Bytes read from + written to the executable store")
+        self._c_saved = registry.counter(
+            "veles_compile_cache_seconds_saved_total",
+            "Recorded compile seconds avoided by cache hits, net of "
+            "deserialization time")
+        self._quarantined = set()   # keys warned about (log once)
+
+    # -- the core ------------------------------------------------------------
+    def get_or_compile(self, jitted, *arg_structs, name="jit",
+                       key_extra=None):
+        """Lower ``jitted`` at ``arg_structs`` and return
+        ``(loaded_or_compiled, cache_hit)``.
+
+        ``cache_hit`` is True when the executable came off disk, False
+        when XLA compiled it fresh (and the entry was persisted).
+        """
+        lowered = jitted.lower(*arg_structs)
+        return self.load_or_compile(lowered, name=name,
+                                    key_extra=key_extra)
+
+    def load_or_compile(self, lowered, name="jit", key_extra=None):
+        """Same contract as :meth:`get_or_compile`, from a Lowered."""
+        key = cache_key(lowered, extra=key_extra)
+        loaded = self._try_load(key, name)
+        if loaded is not None:
+            return loaded, True
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        dt = time.perf_counter() - t0
+        self._c_misses.inc()
+        events.span("compile.miss", dt, fn=name, key=key[:16])
+        self._persist(key, compiled, dt, name)
+        return compiled, False
+
+    def _try_load(self, key, name):
+        blob = self.store.get(key)
+        if blob is None:
+            return None
+        t0 = time.perf_counter()
+        try:
+            entry = pickle.loads(blob)
+            if entry["format"] != _FORMAT or entry["key"] != key:
+                raise ValueError("entry format/key mismatch")
+            from jax.experimental import serialize_executable
+            loaded = serialize_executable.deserialize_and_load(
+                *entry["exe"])
+        except Exception as exc:  # noqa: BLE001 — ANY bad entry: miss
+            self.store.quarantine(key, reason=str(exc)[:120])
+            if key not in self._quarantined:
+                self._quarantined.add(key)
+                log.warning("compile cache: entry %s for %r was corrupt "
+                            "(%s: %s); recompiling", key[:16], name,
+                            type(exc).__name__, str(exc)[:200])
+            return None
+        dt = time.perf_counter() - t0
+        self._c_hits.inc()
+        self._c_bytes.inc(len(blob))
+        self._c_saved.inc(max(0.0,
+                              float(entry.get("compile_seconds", 0.0))
+                              - dt))
+        events.span("compile.cache_hit", dt, fn=name, key=key[:16],
+                    bytes=len(blob))
+        return loaded
+
+    def _persist(self, key, compiled, compile_seconds, name):
+        try:
+            from jax.experimental import serialize_executable
+            exe = serialize_executable.serialize(compiled)
+            blob = pickle.dumps({"format": _FORMAT, "key": key,
+                                 "name": str(name),
+                                 "compile_seconds":
+                                     round(float(compile_seconds), 4),
+                                 "exe": exe},
+                                protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as exc:  # noqa: BLE001 — unserializable
+            # executable (backend without serialization support): the
+            # compile still succeeded, this process just stays warm-only
+            log.info("compile cache: executable for %r not serializable "
+                     "(%s: %s); not persisted", name,
+                     type(exc).__name__, str(exc)[:200])
+            return
+        self._c_bytes.inc(self.store.put(key, blob))
+
+    # -- stats ---------------------------------------------------------------
+    def stats(self):
+        return {"directory": self.store.directory,
+                "entries": len(self.store.entries()),
+                "total_bytes": self.store.total_bytes(),
+                "max_bytes": self.store.max_bytes,
+                "hits": int(self._c_hits.value),
+                "misses": int(self._c_misses.value)}
+
+
+# -- config resolution --------------------------------------------------------
+
+def resolve_config():
+    """(directory_or_None, max_bytes) from
+    ``root.common.compile_cache.{enabled, dir, max_bytes}`` with the
+    :data:`CACHE_DIR_ENV` / :data:`MAX_BYTES_ENV` env fallbacks.  A
+    None directory means the cache is OFF — exact pre-cache behavior."""
+    cfg = root.common.compile_cache
+    if not cfg.get("enabled", True):
+        return None, None
+    directory = cfg.get("dir", None) or os.environ.get(CACHE_DIR_ENV)
+    max_bytes = cfg.get("max_bytes", None)
+    if max_bytes is None and os.environ.get(MAX_BYTES_ENV):
+        try:
+            max_bytes = int(os.environ[MAX_BYTES_ENV])
+        except ValueError:
+            max_bytes = None
+    return (str(directory) if directory else None), max_bytes
+
+
+_instances = {}
+
+
+def default_cache():
+    """The process-wide :class:`CompileCache` for the configured dir,
+    or None when no dir is configured (cache off)."""
+    directory, max_bytes = resolve_config()
+    if not directory:
+        return None
+    key = (os.path.abspath(directory), max_bytes)
+    cache = _instances.get(key)
+    if cache is None:
+        cache = _instances[key] = CompileCache(directory,
+                                               max_bytes=max_bytes)
+    return cache
+
+
+def reset_default_caches():
+    """Drop memoized instances (tests that switch config dirs)."""
+    _instances.clear()
+
+
+def inject_env(env=None):
+    """Return ``env`` (default: a copy of os.environ) with the
+    configured cache dir exported for a child process — how
+    ElasticRunner respawns inherit the cache without re-reading the
+    supervisor's programmatic config.  Also forwards the engine-level
+    JAX persistent compilation cache dir when set."""
+    directory, max_bytes = resolve_config()
+    jax_cc = root.common.engine.get("compilation_cache_dir", None)
+    if not directory and not jax_cc:
+        return env
+    env = dict(os.environ if env is None else env)
+    if directory:
+        env.setdefault(CACHE_DIR_ENV, os.path.abspath(directory))
+        if max_bytes:
+            env.setdefault(MAX_BYTES_ENV, str(int(max_bytes)))
+    if jax_cc:
+        # jax config options read their env default at import time in
+        # the child — the one-knob satellite rides along
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.abspath(str(jax_cc)))
+    return env
+
+
+# -- the training-side adapter ------------------------------------------------
+
+class AotStep:
+    """First-call AOT wrapper around a jitted step function.
+
+    The fused train step's shapes are only known at the first call (the
+    loader owns them), so the wrapper lowers THERE: arg shapes/dtypes
+    become ``ShapeDtypeStruct``s (python int/float scalars pinned to
+    int32/float32, matching what the jit trace would produce), the
+    executable comes from :meth:`CompileCache.get_or_compile`, and
+    every later call runs it directly.
+
+    Safety: on ANY failure — lowering, cache, or executing the loaded
+    executable — the wrapper permanently falls back to the wrapped
+    ``jax.jit`` function (logged once).  Enabling the cache can slow a
+    step down to exactly the old path, never change its result.
+
+    Interface parity with ``jax.jit`` functions where the codebase
+    relies on it: ``__wrapped__`` (scan/mesh steps re-jit from the raw
+    function) and ``_cache_size`` (the StepProfiler's recompile
+    accounting — stays 0 while the AOT path serves every call).
+    """
+
+    def __init__(self, jitted, cache, name, key_extra=None):
+        self._jitted = jitted
+        self._cache = cache
+        self._name = name
+        self._key_extra = key_extra
+        self._compiled = None
+        self._fallback = False
+        self.cache_hit = None       # None until the first call decides
+        wrapped = getattr(jitted, "__wrapped__", None)
+        if wrapped is not None:
+            self.__wrapped__ = wrapped
+
+    def _cache_size(self):
+        fn = getattr(self._jitted, "_cache_size", None)
+        try:
+            return int(fn()) if callable(fn) else 0
+        except Exception:  # noqa: BLE001 — diagnostics never raise
+            return 0
+
+    # scalar pinning: a python int/float traces as a weak 32-bit scalar
+    # under the default x64-off config; the AOT struct pins the same
+    # width strongly and the call-side twin converts to match
+    @staticmethod
+    def _leaf_struct(a):
+        import jax
+        import numpy
+        if isinstance(a, (bool, numpy.bool_)):
+            return jax.ShapeDtypeStruct((), numpy.bool_)
+        if isinstance(a, (int, numpy.integer)):
+            return jax.ShapeDtypeStruct((), numpy.int32)
+        if isinstance(a, (float, numpy.floating)):
+            return jax.ShapeDtypeStruct((), numpy.float32)
+        return jax.ShapeDtypeStruct(numpy.shape(a), a.dtype)
+
+    @staticmethod
+    def _leaf_harden(a):
+        import numpy
+        if isinstance(a, (bool, numpy.bool_)):
+            return numpy.bool_(a)
+        if isinstance(a, (int, numpy.integer)):
+            return numpy.int32(a)
+        if isinstance(a, (float, numpy.floating)):
+            return numpy.float32(a)
+        return a
+
+    def _ensure_compiled(self, args):
+        import jax
+        structs = jax.tree_util.tree_map(self._leaf_struct, args)
+        self._compiled, self.cache_hit = self._cache.get_or_compile(
+            self._jitted, *structs, name=self._name,
+            key_extra=self._key_extra)
+
+    def __call__(self, *args):
+        if not self._fallback:
+            try:
+                if self._compiled is None:
+                    self._ensure_compiled(args)
+                import jax
+                return self._compiled(
+                    *jax.tree_util.tree_map(self._leaf_harden, args))
+            except Exception as exc:  # noqa: BLE001 — never change
+                # results: hand the call to the plain jit path for good
+                self._fallback = True
+                self._compiled = None
+                log.warning("compile cache: AOT path for %r disabled "
+                            "(%s: %s); falling back to jax.jit",
+                            self._name, type(exc).__name__,
+                            str(exc)[:200])
+        return self._jitted(*args)

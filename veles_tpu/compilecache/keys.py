@@ -1,0 +1,48 @@
+"""Cache keys: fingerprint a lowering so stale entries MISS.
+
+A serialized executable is only reusable by a process whose compiler
+would have produced the same binary.  The key therefore hashes the
+program (StableHLO module text — argument donation and shardings are
+part of the text) together with everything that changes codegen out
+from under it: jax/jaxlib versions, the backend platform, the device
+kind and count.  Any drift produces a *different key* — a clean miss
+and a fresh compile — never a deserialization of a wrong or
+incompatible executable.
+"""
+
+import hashlib
+
+
+def environment_fingerprint():
+    """The compilation environment as a stable string: versions,
+    platform, device kind and count.  Split out (and monkeypatchable in
+    tests) so version-mismatch behavior is testable without installing
+    a second jaxlib."""
+    import jax
+    import jaxlib
+    try:
+        devices = jax.devices()
+        platform = devices[0].platform
+        kind = getattr(devices[0], "device_kind", "?")
+        count = len(devices)
+    except Exception:  # noqa: BLE001 — no backend: still a valid key
+        platform, kind, count = "none", "?", 0
+    return "jax=%s;jaxlib=%s;platform=%s;device_kind=%s;devices=%d" % (
+        jax.__version__, jaxlib.__version__, platform, kind, count)
+
+
+def cache_key(lowered, extra=None):
+    """SHA-256 key for a ``jax.stages.Lowered`` (hex string).
+
+    ``extra`` is an optional dict of caller-supplied discriminators
+    (hashed as sorted repr); the module text itself already covers
+    shapes, dtypes, donation and shardings.
+    """
+    h = hashlib.sha256()
+    h.update(lowered.as_text().encode())
+    h.update(b"\x00")
+    h.update(environment_fingerprint().encode())
+    if extra:
+        h.update(b"\x00")
+        h.update(repr(sorted(extra.items())).encode())
+    return h.hexdigest()

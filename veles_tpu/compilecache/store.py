@@ -1,0 +1,138 @@
+"""Content-addressed on-disk executable store.
+
+Durability follows the snapshotter's conventions (snapshotter.py, PR 4):
+every write is ``*.tmp`` + flush + fsync + atomic ``os.rename`` — a
+kill at any point leaves either no entry or a complete one, never a
+truncated file at its final name.  Reads that fail (or entries the
+caller finds undeserializable) are *quarantined*: renamed aside with a
+``.corrupt`` suffix so the next lookup is a clean miss and the evidence
+survives for inspection — a bad cache entry must never crash a start or
+poison a second one.
+
+Eviction is a size-budget LRU sweep: entry mtimes are touched on every
+hit, and when the store exceeds ``max_bytes`` the oldest entries go
+first.  Concurrent processes are safe by construction: writes are
+atomic renames (last writer wins, both wrote the same content for the
+same key) and eviction tolerates entries vanishing underneath it.
+"""
+
+import logging
+import os
+
+log = logging.getLogger("veles_tpu.compilecache")
+
+#: cache entry suffix; quarantined entries get SUFFIX + ".corrupt"
+SUFFIX = ".jexe"
+
+
+class ExecutableStore:
+    """key (hex string) -> bytes blobs under one directory."""
+
+    def __init__(self, directory, max_bytes=None):
+        self.directory = os.path.abspath(directory)
+        self.max_bytes = int(max_bytes) if max_bytes else None
+        os.makedirs(self.directory, exist_ok=True)
+
+    def path_for(self, key):
+        return os.path.join(self.directory, key + SUFFIX)
+
+    # -- read ----------------------------------------------------------------
+    def get(self, key):
+        """The stored blob, or None (miss).  A hit refreshes the entry's
+        mtime — the LRU clock."""
+        path = self.path_for(key)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        try:
+            os.utime(path, None)
+        except OSError:
+            pass                    # concurrently evicted: still a hit
+        return blob
+
+    # -- write ---------------------------------------------------------------
+    def put(self, key, blob):
+        """Atomically persist ``blob`` under ``key``; then sweep the
+        size budget.  Returns the bytes written."""
+        path = self.path_for(key)
+        tmp = path + ".tmp.%d" % os.getpid()
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.rename(tmp, path)
+        except OSError:
+            # a full/read-only cache disk must never fail the caller —
+            # the compile already succeeded; the entry is just not saved
+            log.warning("compile cache: could not persist entry %s under "
+                        "%s", key[:16], self.directory, exc_info=True)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return 0
+        self.evict()
+        return len(blob)
+
+    def quarantine(self, key, reason=""):
+        """Rename a bad entry aside (``.corrupt``) so the next lookup is
+        a clean miss; the caller recompiles.  Idempotent."""
+        path = self.path_for(key)
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            return False
+        # debug, not warning: the cache layer owns the single user-
+        # visible "corrupt entry" warning per key (log-once contract)
+        log.debug("compile cache: quarantined entry %s (%s) -> "
+                  "%s.corrupt", key[:16], reason or "undeserializable",
+                  os.path.basename(path))
+        return True
+
+    # -- accounting / eviction -----------------------------------------------
+    def entries(self):
+        """[(key, size, mtime)] for every live entry (no .corrupt/.tmp)."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(SUFFIX):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue            # raced with eviction elsewhere
+            out.append((name[:-len(SUFFIX)], st.st_size, st.st_mtime))
+        return out
+
+    def total_bytes(self):
+        return sum(size for _, size, _ in self.entries())
+
+    def evict(self):
+        """Drop oldest-used entries until the store fits ``max_bytes``.
+        Returns the number of entries removed."""
+        if not self.max_bytes:
+            return 0
+        entries = self.entries()
+        total = sum(size for _, size, _ in entries)
+        removed = 0
+        for key, size, _ in sorted(entries, key=lambda e: e[2]):
+            if total <= self.max_bytes:
+                break
+            try:
+                os.unlink(self.path_for(key))
+            except OSError:
+                continue
+            total -= size
+            removed += 1
+        if removed:
+            log.info("compile cache: evicted %d entr%s (budget %d bytes)",
+                     removed, "y" if removed == 1 else "ies",
+                     self.max_bytes)
+        return removed

@@ -257,6 +257,27 @@ root.update({
             "precision_level": 0,
             # preferred compute dtype on TPU
             "dtype": "float32",
+            # JAX's built-in persistent compilation cache, applied at
+            # backend init (backends.py): one knob covers every jit the
+            # executable cache (compilecache/) doesn't own.  None = off.
+            "compilation_cache_dir": None,
+            # don't persist XLA cache entries smaller than this
+            "compilation_cache_min_entry_bytes": 0,
+        },
+        "compile_cache": {
+            # persistent AOT executable cache + warmup manifests
+            # (veles_tpu/compilecache/): serving bucket executables and
+            # the fused train step deserialize instead of recompiling
+            # on restart.  None = off (exact pre-cache behavior);
+            # $VELES_COMPILE_CACHE_DIR overrides for child processes.
+            "dir": None,
+            "enabled": True,
+            # size-budget LRU sweep over the store directory
+            "max_bytes": 4 << 30,
+            # serving warmup: compile the first manifest bucket
+            # synchronously, the rest of the ladder on a background
+            # thread (the server answers before the tail finishes)
+            "background_warmup": False,
         },
         "loader": {
             # background minibatch prefetch lookahead on the per-step

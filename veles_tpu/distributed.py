@@ -29,6 +29,7 @@ import subprocess
 import sys
 import time
 
+from .compilecache import inject_env as _cache_inject_env
 from .observability import trace as _trace
 from .units import Unit
 
@@ -108,6 +109,10 @@ class ElasticRunner:
         # every (re)launch joins the supervisor's trace: crash-restart
         # chains then read as one causal timeline in the merged trace
         env = _trace.inject_env(self.env)
+        # ...and inherits the compile caches (VELES_COMPILE_CACHE_DIR /
+        # JAX_COMPILATION_CACHE_DIR): a respawn then deserializes its
+        # fused-step executables instead of re-paying XLA compilation
+        env = _cache_inject_env(env)
         while True:
             argv = [self.python, "-m", "veles_tpu", self.model] + self.argv
             snapshot = latest_snapshot(self.snapshot_dir, self.prefix)

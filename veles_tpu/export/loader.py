@@ -10,6 +10,7 @@ the C++ runner (native/) reads the same layout.
 
 import io
 import json
+import threading
 import zipfile
 
 import numpy
@@ -37,6 +38,7 @@ class PackageLoader:
             self._artifact = (zf.read("model.stablehlo")
                               if "model.stablehlo" in names else None)
         self._exported = None
+        self._exported_lock = threading.Lock()
 
     @property
     def workflow_name(self):
@@ -60,9 +62,14 @@ class PackageLoader:
     def deserialize(self):
         if self._artifact is None:
             raise ValueError("package has no model.stablehlo artifact")
+        # double-checked lock: two concurrent FIRST requests must not
+        # both deserialize and race the assignment — one pays the
+        # deserialization, the loser reuses it
         if self._exported is None:
-            from jax import export as jexport
-            self._exported = jexport.deserialize(self._artifact)
+            with self._exported_lock:
+                if self._exported is None:
+                    from jax import export as jexport
+                    self._exported = jexport.deserialize(self._artifact)
         return self._exported
 
     def run(self, x):

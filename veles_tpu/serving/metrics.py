@@ -115,6 +115,15 @@ class ServingMetrics:
         self._h_latency = self.registry.histogram(
             "veles_serving_request_seconds",
             "End-to-end request latency", ("model",)).labels(model=model)
+        # seconds counter (floats — kept out of the int _COUNTERS
+        # surface): warmup cost per model; with a warm executable cache
+        # a restart's total shrinks to deserialization time (~0)
+        self._c_compile_s = self.registry.counter(
+            "veles_serving_compile_seconds_total",
+            "Wall seconds spent producing bucket executables "
+            "(fresh compiles and cache loads)",
+            ("model",)).labels(model=model)
+        self._base_compile_s = self._c_compile_s.value
         # scrape-time gauges derived from the exact-quantile window and
         # the fill counters (refreshed via collect_metrics just before
         # every /metrics render — Prometheus quantile gauges would be
@@ -154,6 +163,10 @@ class ServingMetrics:
     def record_reject(self):
         self._c["rejected"].inc()
         events.event("serving.reject", model=self.model)
+
+    def record_compile(self, seconds):
+        """One bucket executable produced (compile or cache load)."""
+        self._c_compile_s.inc(float(seconds))
 
     # -- dispatch-side -------------------------------------------------------
     def record_batch(self, bucket, rows, seconds, n_requests, links=None):
@@ -198,6 +211,8 @@ class ServingMetrics:
         padded = counters["padded_rows"]
         out = dict(counters)
         out.update({
+            "compile_seconds": round(
+                self._c_compile_s.value - self._base_compile_s, 4),
             "uptime_s": round(uptime, 1),
             "lifetime_rps": round(counters["requests"] / uptime, 2),
             "recent_rps": recent_rps,

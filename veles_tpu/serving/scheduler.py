@@ -32,6 +32,7 @@ from concurrent.futures import Future
 
 import numpy
 
+from ..compilecache import WarmupManifest, default_cache
 from ..logger import events
 from ..observability import trace as _trace
 from .metrics import ServingMetrics
@@ -74,13 +75,22 @@ class JaxModel:
         self._params = jax.device_put(params)
         self.sample_shape = tuple(int(d) for d in sample_shape)
 
-    def compile(self, bucket):
+    def compile(self, bucket, cache=None):
+        """-> (runner, cache_hit): the bucket's executable, off the
+        persistent cache when one is active (hit True/False) or a plain
+        AOT compile (hit None)."""
         import jax
         struct = jax.ShapeDtypeStruct((int(bucket),) + self.sample_shape,
                                       numpy.float32)
-        compiled = self._jit.lower(self._params, struct).compile()
+        hit = None
+        if cache is not None:
+            compiled, hit = cache.get_or_compile(
+                self._jit, self._params, struct,
+                name="serving.bucket%d" % int(bucket))
+        else:
+            compiled = self._jit.lower(self._params, struct).compile()
         params = self._params
-        return lambda xs: compiled(params, xs)
+        return (lambda xs: compiled(params, xs)), hit
 
     def jit_cache_size(self):
         """Eager-jit cache entries — stays 0 when every call went
@@ -99,8 +109,8 @@ class OpaqueModel:
         self.sample_shape = (tuple(int(d) for d in sample_shape)
                              if sample_shape is not None else None)
 
-    def compile(self, bucket):
-        return self._fn
+    def compile(self, bucket, cache=None):
+        return self._fn, None
 
     def jit_cache_size(self):
         return None
@@ -112,6 +122,8 @@ def adapt_model(model, sample_shape=None):
     Accepts a package path, a PackageLoader, anything with a non-empty
     ``forwards`` chain (StandardWorkflow), or a bare callable.
     """
+    if isinstance(model, (JaxModel, OpaqueModel)):
+        return model                # pre-built adapter (tests, tools)
     if isinstance(model, str):
         from ..export.loader import PackageLoader
         model = PackageLoader(model)
@@ -164,7 +176,9 @@ class BucketScheduler:
 
     def __init__(self, model, max_batch=64, queue_limit=256, workers=1,
                  max_wait=0.0, warmup=True, name="default",
-                 metrics=None, sample_shape=None):
+                 metrics=None, sample_shape=None, cache=None,
+                 manifest=None, background_warmup=None):
+        from ..config import root
         self.name = name
         self.max_batch = int(max_batch)
         self.queue_limit = int(queue_limit)
@@ -174,9 +188,29 @@ class BucketScheduler:
         self.sample_shape = self._adapter.sample_shape
         self.buckets = bucket_sizes(self.max_batch)
         self._executables = {}
-        self._compiles = 0
+        self._compiles = 0              # fresh XLA compiles only
+        self._cache_hits = 0            # executables loaded off disk
+        self._compile_seconds = 0.0
         self._warmup_compiles = 0
         self._compile_lock = threading.Lock()
+        # the persistent executable cache + warmup manifest (compilecache
+        # subsystem): None kwargs resolve from root.common.compile_cache
+        # — no configured dir means both stay off (seed behavior)
+        if cache is None:
+            cache = default_cache()
+        self._cache = cache or None     # cache=False forces OFF
+        if manifest is None:
+            self._manifest = (self._cache.manifest
+                              if self._cache is not None else None)
+        elif isinstance(manifest, str):
+            self._manifest = WarmupManifest(manifest)
+        else:
+            self._manifest = manifest or None
+        if background_warmup is None:
+            background_warmup = bool(root.common.compile_cache.get(
+                "background_warmup", False))
+        self._background_warmup = bool(background_warmup)
+        self._warmup_thread = None
         self._queue = queue.Queue()     # unbounded; bound enforced below
         self._depth = 0                 # outstanding requests
         self._depth_lock = threading.Lock()
@@ -191,27 +225,76 @@ class BucketScheduler:
             t.start()
 
     # -- compilation ---------------------------------------------------------
-    def warmup(self):
+    def _warmup_order(self):
+        """The ladder, warmup-manifest buckets first: a restart warms
+        the shapes real traffic used before the speculative tail."""
+        order = list(self.buckets)
+        if self._manifest is None:
+            return order
+        first = [b for b in self._manifest.buckets(self.name)
+                 if b in order]
+        return first + [b for b in order if b not in first]
+
+    def warmup(self, background=None):
         """Compile every bucket up front so steady state never compiles.
 
         Buckets the model cannot take (a static-batch package artifact)
         are dropped from the ladder instead of failing the whole model;
-        at least one bucket must survive.
+        at least one bucket must survive.  With ``background`` (default:
+        the ``background_warmup`` knob) the tail of the ladder compiles
+        on a daemon thread after the first usable bucket, so a server
+        answers its first warm bucket before the tail finishes — on a
+        warm cache the whole ladder is deserialization-fast anyway.
         """
+        if background is None:
+            background = self._background_warmup
+        pending = self._warmup_order()
         usable = []
-        for b in self.buckets:
-            try:
-                self._get_executable(b)
+        while pending:                 # sync until one bucket works
+            b = pending.pop(0)
+            if self._warm_one(b):
                 usable.append(b)
-            except Exception as exc:
-                events.event("serving.warmup_skip", model=self.name,
-                             bucket=b, error=str(exc)[:200])
+                break
         if not usable:
             raise ValueError(
                 "model %r compiled for no bucket size" % self.name)
-        self.buckets = usable
-        self.max_batch = usable[-1]
+        if background and pending:
+            self.buckets = sorted(usable + pending)
+            self.max_batch = self.buckets[-1]
+            self._warmup_compiles = self._compiles
+            self._warmup_thread = threading.Thread(
+                target=self._warmup_tail, args=(pending,), daemon=True,
+                name="veles-serve-%s-warmup" % self.name)
+            self._warmup_thread.start()
+            return
+        for b in pending:
+            if self._warm_one(b):
+                usable.append(b)
+        self.buckets = sorted(usable)
+        self.max_batch = self.buckets[-1]
         self._warmup_compiles = self._compiles
+
+    def _warm_one(self, bucket):
+        try:
+            self._get_executable(bucket)
+            return True
+        except Exception as exc:  # noqa: BLE001 — drop, don't fail all
+            events.event("serving.warmup_skip", model=self.name,
+                         bucket=bucket, error=str(exc)[:200])
+            return False
+
+    def _warmup_tail(self, pending):
+        """Background tail: compile the rest of the ladder, pruning
+        buckets the model rejects; tail compiles count as warmup."""
+        for b in pending:
+            if self._closed:
+                return
+            ok = self._warm_one(b)
+            with self._compile_lock:
+                if not ok:
+                    self.buckets = [x for x in self.buckets if x != b]
+                    self.max_batch = self.buckets[-1]
+                self._warmup_compiles = self._compiles
 
     def _get_executable(self, bucket):
         run = self._executables.get(bucket)
@@ -221,12 +304,23 @@ class BucketScheduler:
             run = self._executables.get(bucket)
             if run is None:
                 t0 = time.perf_counter()
-                run = self._adapter.compile(bucket)
-                self._compiles += 1
+                run, hit = self._adapter.compile(bucket,
+                                                 cache=self._cache)
+                dt = time.perf_counter() - t0
+                if hit:
+                    self._cache_hits += 1
+                else:
+                    self._compiles += 1
+                self._compile_seconds += dt
+                self.metrics.record_compile(dt)
                 self._executables[bucket] = run
-                events.span("serving.compile",
-                            time.perf_counter() - t0,
-                            model=self.name, bucket=int(bucket))
+                events.span("serving.compile", dt, model=self.name,
+                            bucket=int(bucket),
+                            cache_hit=bool(hit) if hit is not None
+                            else None)
+                if self._manifest is not None:
+                    self._manifest.record(self.name, bucket,
+                                          self.sample_shape)
         return run
 
     def _bucket_for(self, rows):
@@ -415,14 +509,33 @@ class BucketScheduler:
     def queue_depth(self):
         return self._depth
 
+    def join_warmup(self, timeout=None):
+        """Block until a background warmup tail finishes (no-op when
+        warmup was synchronous).  Returns True when nothing is left
+        warming."""
+        t = self._warmup_thread
+        if t is not None:
+            t.join(timeout)
+            return not t.is_alive()
+        return True
+
     def stats(self):
-        """Executable-cache accounting — the zero-recompile evidence."""
+        """Executable-cache accounting — the zero-recompile evidence.
+
+        ``compiles`` counts FRESH XLA compilations only; executables
+        deserialized off the persistent cache land in ``cache_hits``
+        (a warm-cache restart therefore shows ``compiles == 0``).
+        """
         return {
             "buckets": list(self.buckets),
             "executables": len(self._executables),
             "compiles": self._compiles,
+            "cache_hits": self._cache_hits,
+            "compile_seconds": round(self._compile_seconds, 4),
             "warmup_compiles": self._warmup_compiles,
             "post_warmup_compiles": self._compiles - self._warmup_compiles,
+            "warming": (self._warmup_thread.is_alive()
+                        if self._warmup_thread is not None else False),
             "jit_cache_size": self._adapter.jit_cache_size(),
             "queue_depth": self._depth,
             "queue_limit": self.queue_limit,

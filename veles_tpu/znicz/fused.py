@@ -18,6 +18,7 @@ evaluator exposes, so Decision units work unchanged.
 
 import numpy
 
+from ..compilecache import AotStep, default_cache
 from ..config import root
 from ..memory import Array
 from ..result_provider import IResultProvider
@@ -296,6 +297,23 @@ class FusedTrainStep(Unit, IResultProvider):
             self._train_step_g_ = jax.jit(train_step_g,
                                           donate_argnums=(2, 3, 4))
             self._eval_step_g_ = jax.jit(eval_step_g, donate_argnums=(3,))
+        # persistent executable cache (compilecache subsystem): wrap the
+        # jitted steps so an ElasticRunner respawn / snapshot restore
+        # deserializes yesterday's executable instead of recompiling.
+        # AotStep keeps __wrapped__ (the scan/mesh steps re-jit from the
+        # raw function) and falls back to the plain jit path on any
+        # surprise; no configured cache dir = exactly the code above
+        cache = default_cache()
+        if cache is not None:
+            self._train_step_ = AotStep(self._train_step_, cache,
+                                        "fused.train_step")
+            self._eval_step_ = AotStep(self._eval_step_, cache,
+                                       "fused.eval_step")
+            if self._use_gather_:
+                self._train_step_g_ = AotStep(self._train_step_g_, cache,
+                                              "fused.train_step_gather")
+                self._eval_step_g_ = AotStep(self._eval_step_g_, cache,
+                                             "fused.eval_step_gather")
         # copy: the step donates its param buffers, so they must not alias
         # the forward units' live weight Arrays
         self._params_ = [
