@@ -61,6 +61,14 @@ Also reported in the same JSON line:
   per-request dispatch on the same exported MNIST package, with
   ``serve_post_warmup_compiles`` recording the zero-recompile
   guarantee.
+- ``decode_tok_s`` + ``decode_vs_static_speedup`` +
+  ``decode_token_p99_ms`` + ``decode_ttft_p50_ms`` +
+  ``decode_post_warmup_compiles`` + ``decode_warm_compiles`` — the
+  token-level decode path (ISSUE 6): continuous batching over the
+  paged KV cache vs request-granularity gangs on the SAME flagship
+  decode executables (tools/serve_bench.py --decode), run cold then
+  warm in fresh subprocesses so ``decode_warm_compiles == 0`` proves
+  the zero-recompile restart via the compile-cache manifest.
 - ``snapshot_stall_speedup`` + ``snapshot_stall_{sync,async}_ms`` +
   ``snapshot_write_gz{9,6}_ms`` — the checkpointing path (ISSUE 4):
   per-snapshot training-thread stall on the MNIST step loop with the
@@ -689,6 +697,58 @@ def bench_cold_start(max_batch=16, probe_timeout=150):
     return out
 
 
+def bench_decode(probe_timeout=240):
+    """Token-level continuous batching vs request-granularity gangs on
+    the flagship decode path (ISSUE 6 acceptance: higher sustained
+    tok/s on the same mixed prompt/output-length traffic, zero
+    steady-state recompiles, proven across a warm restart).  Each probe
+    is a FRESH subprocess running ``tools/serve_bench.py --decode``
+    (the cold_start pattern): the first populates the executable cache,
+    the second IS the warm restart being measured."""
+    import subprocess
+    import tempfile
+    _stamp("decode stage")
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "serve_bench.py")
+    cache_dir = os.path.join(
+        tempfile.mkdtemp(prefix="veles-decode-bench-"), "compile_cache")
+
+    def probe(tag):
+        argv = [sys.executable, tool, "--decode", "--seconds", "2",
+                "--decode-requests", "64", "--json",
+                "--cache-dir", cache_dir]
+        proc = subprocess.run(argv, capture_output=True,
+                              timeout=probe_timeout)
+        line = _last_json_line(proc.stdout.decode())
+        if line is None:
+            raise RuntimeError("decode probe (%s) failed: %s"
+                               % (tag, proc.stderr.decode()[-400:]))
+        _stamp("decode %s: %.1f tok/s (%.2fx vs static), warmup %.2fs,"
+               " %s compiles" % (tag, line.get("decode_tok_s") or -1,
+                                 line.get("decode_vs_static_speedup")
+                                 or -1, line.get("decode_warmup_s", -1),
+                                 line.get("decode_compiles")))
+        return line
+
+    cold = probe("cold")
+    warm = probe("warm")        # the restart: manifest + cache replay
+    out = {"decode_tok_s": warm.get("decode_tok_s"),
+           "decode_static_tok_s": warm.get("decode_static_tok_s"),
+           "decode_vs_static_speedup":
+               warm.get("decode_vs_static_speedup"),
+           "decode_token_p50_ms": warm.get("decode_token_p50_ms"),
+           "decode_token_p99_ms": warm.get("decode_token_p99_ms"),
+           "decode_ttft_p50_ms": warm.get("decode_ttft_p50_ms"),
+           "decode_row_fill": warm.get("decode_row_fill"),
+           "decode_post_warmup_compiles":
+               warm.get("decode_post_warmup_compiles"),
+           "decode_cold_warmup_s": cold.get("decode_warmup_s"),
+           "decode_warm_warmup_s": warm.get("decode_warmup_s"),
+           "decode_warm_compiles": warm.get("decode_compiles"),
+           "decode_warm_cache_hits": warm.get("decode_cache_hits")}
+    return out
+
+
 def bench_observability(batch=512, steps=64, repeats=5):
     """Tracing+metrics overhead on the MNIST per-step loop (ISSUE 2
     acceptance: < 5%): the SAME per-launch step loop timed bare, then
@@ -923,6 +983,8 @@ def _stage_main(stage):
         out = bench_snapshot()
     elif stage == "cold_start":
         out = bench_cold_start()
+    elif stage == "decode":
+        out = bench_decode()
     else:
         raise SystemExit("unknown stage %r" % stage)
     out["spread"] = SPREAD
@@ -971,6 +1033,11 @@ STAGE_PLAN = [
     # six fresh subprocesses, each its own import+compile, so this
     # stage needs real wall clock despite doing almost no device work
     ("cold_start", 420),
+    # token-level continuous batching vs request-granularity gangs on
+    # the flagship decode path (ISSUE 6 acceptance: tok/s up, zero
+    # steady-state recompiles across a warm restart) — two fresh
+    # subprocesses (cold populates the cache, warm IS the restart)
+    ("decode", 420),
 ]
 
 

@@ -336,6 +336,152 @@ def run_bench(package=None, clients=8, seconds=2.0, sizes=DEFAULT_SIZES,
     return out
 
 
+# -- decode load mode ---------------------------------------------------------
+#
+# The token-level counterpart of the request benchmark above (ISSUE 6):
+# the SAME mixed prompt/output-length traffic is served twice by the
+# SAME DecodeScheduler (same executables, same KV pools) under two load
+# patterns —
+#
+# - ``continuous``: every request submitted up front; the scheduler
+#   admits a new sequence the moment a row frees (token-level
+#   continuous batching);
+# - ``static``: requests submitted in gangs of max_batch, the next gang
+#   only after the whole gang finishes — exactly the request-
+#   granularity bucket policy, where every early-finishing row idles
+#   until the gang's straggler completes.
+#
+# The tok/s ratio between them isolates the SCHEDULING policy: kernels,
+# pools and compilation are shared, so nothing else differs.  An
+# optional paced open-loop window (--offered-rps) reports achieved
+# tok/s, shed count and tail latency the way decode SLOs are stated.
+
+
+def _decode_requests(n, max_prompt_len, max_new_tokens, vocab, seed=7):
+    """The mixed-length request set: prompt/output lengths uniform over
+    the full supported range (the raggedness the scheduler must absorb)."""
+    rng = numpy.random.RandomState(seed)
+    return [(rng.randint(0, vocab, rng.randint(
+        1, max_prompt_len + 1)).tolist(),
+        int(rng.randint(1, max_new_tokens + 1)))
+        for _ in range(n)]
+
+
+def _run_continuous(scheduler, requests):
+    t0 = time.perf_counter()
+    futures = [scheduler.submit(p, n) for p, n in requests]
+    results = [f.result(120) for f in futures]
+    elapsed = time.perf_counter() - t0
+    tokens = sum(len(r["tokens"]) for r in results)
+    return tokens, elapsed, results
+
+
+def _run_static(scheduler, requests, gang):
+    """Request-granularity gangs: admit ``gang`` sequences, wait for
+    ALL of them before admitting the next gang."""
+    t0 = time.perf_counter()
+    tokens = 0
+    for i in range(0, len(requests), gang):
+        futures = [scheduler.submit(p, n)
+                   for p, n in requests[i:i + gang]]
+        tokens += sum(len(f.result(120)["tokens"]) for f in futures)
+    return tokens, time.perf_counter() - t0
+
+
+def run_decode_bench(seconds=2.0, n_requests=None, max_batch=8,
+                     block_size=8, max_prompt_len=16, max_new_tokens=16,
+                     offered_rps=None, rounds=2, cache_dir=None):
+    """Continuous vs static decode throughput on the flagship
+    transformer; returns the result dict (keys ride into the bench
+    JSON like the request path's ``serve_rps``)."""
+    from veles_tpu.serving import DecodeScheduler, SchedulerOverflow
+    from veles_tpu.znicz.samples.flagship import FlagshipDecodeModel
+
+    if cache_dir:
+        from veles_tpu.config import root
+        root.common.compile_cache.dir = cache_dir
+    model = FlagshipDecodeModel(stages=2, experts=2, d=32, heads=2,
+                                hidden=64, vocab=128, seed=0)
+    t0 = time.perf_counter()
+    scheduler = DecodeScheduler(
+        model, max_batch=max_batch, block_size=block_size,
+        max_prompt_len=max_prompt_len, max_new_tokens=max_new_tokens,
+        queue_limit=4096, name="decode_bench")
+    warmup_s = time.perf_counter() - t0
+    if n_requests is None:
+        # sized so one continuous window runs ~`seconds` (rough CPU
+        # budget); static rounds reuse the same set
+        n_requests = max(4 * max_batch, int(16 * seconds))
+    requests = _decode_requests(n_requests, max_prompt_len,
+                                max_new_tokens, model.vocab)
+    out = {"decode_requests": n_requests, "decode_max_batch": max_batch,
+           "decode_block_size": block_size,
+           "decode_max_prompt_len": max_prompt_len,
+           "decode_max_new_tokens": max_new_tokens,
+           "decode_warmup_s": round(warmup_s, 4)}
+    try:
+        # warm both load patterns untimed (first D2H, allocator paths)
+        _run_continuous(scheduler, requests[:max_batch])
+        _run_static(scheduler, requests[:max_batch], max_batch)
+        warm_stats = scheduler.stats()
+        cont = {"tokens": 0, "t": 0.0}
+        stat = {"tokens": 0, "t": 0.0}
+        results = None
+        for _ in range(max(1, rounds)):    # interleaved: drift cancels
+            tok, dt, results = _run_continuous(scheduler, requests)
+            cont["tokens"] += tok
+            cont["t"] += dt
+            tok, dt = _run_static(scheduler, requests, max_batch)
+            stat["tokens"] += tok
+            stat["t"] += dt
+        out["decode_tok_s"] = round(cont["tokens"] / cont["t"], 1)
+        out["decode_static_tok_s"] = round(stat["tokens"] / stat["t"],
+                                           1)
+        out["decode_vs_static_speedup"] = round(
+            out["decode_tok_s"] / out["decode_static_tok_s"], 2)
+        ttft = sorted(r["ttft_s"] for r in results)
+        pick = lambda q: ttft[min(len(ttft) - 1,  # noqa: E731
+                                  int(q * len(ttft)))]
+        out["decode_ttft_p50_ms"] = round(pick(0.50) * 1e3, 3)
+        out["decode_ttft_p99_ms"] = round(pick(0.99) * 1e3, 3)
+        snap = scheduler.metrics.snapshot()
+        for q in ("p50_ms", "p95_ms", "p99_ms"):
+            out["decode_token_%s" % q] = snap["step_latency"][q]
+        out["decode_row_fill"] = snap["row_fill"]
+        stats = scheduler.stats()
+        out["decode_compiles"] = stats["compiles"]
+        out["decode_cache_hits"] = stats["cache_hits"]
+        out["decode_post_warmup_compiles"] = (
+            stats["compiles"] - warm_stats["compiles"])
+        out["decode_free_blocks"] = stats["free_blocks"]
+
+        if offered_rps:
+            # paced open loop: arrivals at offered_rps requests/s
+            shed = done_tokens = 0
+            futures = []
+            start = time.perf_counter()
+            n_arrivals = max(1, int(offered_rps * seconds))
+            for k in range(n_arrivals):
+                due = start + k / offered_rps
+                delay = due - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                prompt, n = requests[k % len(requests)]
+                try:
+                    futures.append(scheduler.submit(prompt, n))
+                except SchedulerOverflow:
+                    shed += 1
+            for f in futures:
+                done_tokens += len(f.result(120)["tokens"])
+            elapsed = time.perf_counter() - start
+            out["decode_open_offered_rps"] = offered_rps
+            out["decode_open_tok_s"] = round(done_tokens / elapsed, 1)
+            out["decode_open_shed"] = shed
+    finally:
+        scheduler.close(drain=True)
+    return out
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="serve_bench",
@@ -365,7 +511,45 @@ def main(argv=None):
                         "half the measured closed-loop serve_rps)")
     p.add_argument("--json", action="store_true",
                    help="print only the final JSON line")
+    p.add_argument("--decode", action="store_true",
+                   help="token-level decode load mode: continuous vs "
+                        "static-gang batching on the flagship decode "
+                        "model (tok/s, per-token tails, TTFT)")
+    p.add_argument("--decode-max-batch", type=int, default=8)
+    p.add_argument("--decode-block-size", type=int, default=8)
+    p.add_argument("--decode-max-prompt", type=int, default=16)
+    p.add_argument("--decode-max-new", type=int, default=16)
+    p.add_argument("--decode-requests", type=int, default=None)
+    p.add_argument("--cache-dir", default=None,
+                   help="persistent executable cache dir (decode mode; "
+                        "run twice to prove the zero-recompile warm "
+                        "restart)")
     args = p.parse_args(argv)
+
+    if args.decode:
+        out = run_decode_bench(
+            seconds=args.seconds, n_requests=args.decode_requests,
+            max_batch=args.decode_max_batch,
+            block_size=args.decode_block_size,
+            max_prompt_len=args.decode_max_prompt,
+            max_new_tokens=args.decode_max_new,
+            offered_rps=args.offered_rps, cache_dir=args.cache_dir)
+        line = {"metric": "decode_tok_s",
+                "value": out.get("decode_tok_s"), "unit": "tok/s"}
+        line.update(out)
+        if not args.json:
+            print("decode bench: %s tok/s continuous vs %s tok/s "
+                  "static gangs (%sx), token p99 %s ms, ttft p50 %s "
+                  "ms, %s post-warmup compiles"
+                  % (out.get("decode_tok_s"),
+                     out.get("decode_static_tok_s"),
+                     out.get("decode_vs_static_speedup"),
+                     out.get("decode_token_p99_ms"),
+                     out.get("decode_ttft_p50_ms"),
+                     out.get("decode_post_warmup_compiles")),
+                  file=sys.stderr)
+        print(json.dumps(line))
+        return 0
 
     kwargs = dict(
         package=args.package, clients=args.clients,

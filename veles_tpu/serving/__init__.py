@@ -4,14 +4,19 @@ The TPU-native counterpart of the reference's standalone inference
 runtime (libVeles beside the trainer): turn a trained workflow or an
 exported package into a production HTTP service.
 
-- :mod:`.scheduler` — micro-batching onto warm, shape-bucketed XLA
-  executables (power-of-two padding, AOT warmup, zero steady-state
-  recompilation, bounded-queue backpressure);
+- :mod:`.scheduler` — request-granularity micro-batching onto warm,
+  shape-bucketed XLA executables (power-of-two padding, AOT warmup,
+  zero steady-state recompilation, bounded-queue backpressure);
+- :mod:`.decode` — token-level continuous batching for autoregressive
+  decode: per-step admit/retire against ONE warm executable, prompt
+  prefill through a length-bucket ladder;
+- :mod:`.kvcache` — the paged KV cache's host-side block allocator
+  (free list + page tables over the preallocated device pools);
 - :mod:`.registry` — several named, hot-loadable models per server;
 - :mod:`.server` — the HTTP front end (429 load shedding, graceful
   drain, ``/metrics`` + ``/healthz``);
-- :mod:`.metrics` — latency histograms, batch-fill, req/s, wired into
-  the Chrome-trace event log.
+- :mod:`.metrics` — latency histograms, batch-fill, req/s and decode
+  tok/s + TTFT, wired into the Chrome-trace event log.
 
 Quickstart::
 
@@ -20,15 +25,22 @@ Quickstart::
     # POST http://127.0.0.1:8080/api/mnist {"input": [[...784...]]}
     server.stop()
 
-or from the CLI: ``python -m veles_tpu --serve mnist_pkg.zip``.
+or from the CLI: ``python -m veles_tpu --serve mnist_pkg.zip``.  For
+decode serving, register a decode adapter (e.g.
+``znicz.samples.flagship.FlagshipDecodeModel()``) and POST
+``{"prompt": [...], "max_new_tokens": n}`` to ``/api/<name>/generate``.
 """
 
-from .metrics import LatencyWindow, ServingMetrics
-from .registry import ModelRegistry, ServedModel
+from .decode import DecodeScheduler
+from .kvcache import KVBlockPool
+from .metrics import DecodeMetrics, LatencyWindow, ServingMetrics
+from .registry import DecodeServedModel, ModelRegistry, ServedModel
 from .scheduler import (BucketScheduler, SchedulerClosed,
                         SchedulerOverflow, bucket_sizes)
 from .server import InferenceServer
 
-__all__ = ["BucketScheduler", "InferenceServer", "LatencyWindow",
-           "ModelRegistry", "ServedModel", "SchedulerClosed",
-           "SchedulerOverflow", "ServingMetrics", "bucket_sizes"]
+__all__ = ["BucketScheduler", "DecodeMetrics", "DecodeScheduler",
+           "DecodeServedModel", "InferenceServer", "KVBlockPool",
+           "LatencyWindow", "ModelRegistry", "ServedModel",
+           "SchedulerClosed", "SchedulerOverflow", "ServingMetrics",
+           "bucket_sizes"]

@@ -1,0 +1,517 @@
+"""Token-level continuous batching over a paged KV cache.
+
+:class:`BucketScheduler` batches at REQUEST granularity — right for
+fixed-shape classifiers, wrong for autoregressive decode, where
+sequences finish at different times and a static batch leaves rows idle
+from the first early finish to the last straggler.  This scheduler is
+the Orca-style alternative (PAPERS.md; "Ragged Paged Attention", arXiv
+2604.15464): scheduling decisions happen **every token step**, not
+every request —
+
+- one warm decode executable with STATIC shapes (``max_batch`` rows ×
+  the ``[max_batch, max_blocks]`` page-table operand) runs the whole
+  lifetime of the server: admitting a sequence writes integers into
+  the page table, retiring one returns its blocks to the free list,
+  and the executable never recompiles (``stats()["compiles"]`` is flat
+  after warmup, across restarts via the compile cache + warmup
+  manifest);
+- prompt prefill goes through a power-of-two length ladder (the same
+  bucket discipline — and the same persistent-executable plumbing — as
+  the request path), one sequence per prefill;
+- K/V lives in fixed-size blocks of a preallocated device pool
+  (:mod:`.kvcache` owns placement; znicz/paged_attention.py gathers
+  through the page table), so memory is allocated per sequence LENGTH,
+  not per ``max_batch x max_context`` rectangle;
+- backpressure is a bounded queue: beyond ``queue_limit`` outstanding
+  requests :meth:`submit` raises :class:`SchedulerOverflow` and the
+  server answers 429 + Retry-After.
+
+The single worker thread owns every mutable: the block pool, the page
+table, the session map, and the device pool handles (the decode
+executable donates and returns them).  ``submit`` only validates and
+enqueues — the cross-thread surface is one Queue and one Future per
+request.
+"""
+
+import collections
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy
+
+from ..compilecache import WarmupManifest, default_cache
+from ..logger import events
+from ..observability import trace as _trace
+from .kvcache import KVBlockPool, required_blocks
+from .metrics import DecodeMetrics
+from .scheduler import SchedulerClosed, SchedulerOverflow, bucket_sizes
+
+_STOP = object()
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new_tokens", "future", "enqueued",
+                 "trace")
+
+    def __init__(self, prompt, max_new_tokens):
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.future = Future()
+        self.enqueued = time.perf_counter()
+        self.trace = _trace.current()
+
+
+class _Session:
+    """One admitted sequence: its row, blocks, and token state."""
+
+    __slots__ = ("req", "row", "blocks", "length", "next_input",
+                 "generated", "first_token_s")
+
+    def __init__(self, req, row, blocks):
+        self.req = req
+        self.row = row
+        self.blocks = blocks
+        self.length = 0          # tokens in the KV cache
+        self.next_input = 0      # last emitted token (next step's input)
+        self.generated = []
+        self.first_token_s = None
+
+    @property
+    def done(self):
+        return len(self.generated) >= self.req.max_new_tokens
+
+
+class DecodeScheduler:
+    """Admit/retire sequences every step against one warm executable.
+
+    ``model`` is a decode adapter (e.g.
+    :class:`veles_tpu.znicz.samples.flagship.FlagshipDecodeModel`):
+    ``make_pools(num_blocks, block_size)``, ``prefill_fn(block_size)``,
+    ``decode_fn(block_size)``, ``vocab``.
+
+    Geometry: ``max_batch`` concurrent sequences, each at most
+    ``max_prompt_len`` prompt + ``max_new_tokens`` generated tokens,
+    stored in ``block_size``-token blocks.  ``num_blocks`` defaults to
+    full occupancy (every row at max context) + the reserved trash
+    block; size it smaller to oversubscribe memory, in which case
+    admission also waits for free blocks.
+    """
+
+    def __init__(self, model, *, max_batch=8, block_size=8,
+                 max_prompt_len=32, max_new_tokens=32, num_blocks=None,
+                 queue_limit=64, name="decode", metrics=None,
+                 cache=None, manifest=None, warmup=True):
+        self.name = name
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.block_size = int(block_size)
+        self.max_prompt_len = int(max_prompt_len)
+        self.max_new_tokens = int(max_new_tokens)
+        self.queue_limit = int(queue_limit)
+        self.max_context = self.max_prompt_len + self.max_new_tokens
+        self.max_blocks = required_blocks(self.max_context,
+                                          self.block_size)
+        if num_blocks is None:
+            num_blocks = self.max_batch * self.max_blocks + 1
+        self.metrics = metrics or DecodeMetrics(name)
+        self.prefill_buckets = bucket_sizes(self.max_prompt_len)
+        self._pool = KVBlockPool(num_blocks, self.block_size)
+        if not self._pool.fits(self.max_context):
+            raise ValueError(
+                "num_blocks=%d cannot hold even one max-context "
+                "sequence (%d tokens need %d blocks of %d)"
+                % (num_blocks, self.max_context, self.max_blocks,
+                   self.block_size))
+        self._k_pools, self._v_pools = model.make_pools(
+            num_blocks, self.block_size)
+        # numpy mirrors of the step operands; the worker edits them on
+        # admit/retire and ships them whole every step
+        self._np_table = numpy.zeros((self.max_batch, self.max_blocks),
+                                     numpy.int32)
+        self._np_lengths = numpy.zeros(self.max_batch, numpy.int32)
+        self._np_tokens = numpy.zeros(self.max_batch, numpy.int32)
+        self._sessions = {}          # row -> _Session
+        self._pending = collections.deque()
+        self._queue = queue.Queue()
+        self._depth = 0              # queued + pending + active
+        self._depth_lock = threading.Lock()
+        self._closed = False
+        self._abort = False
+        # compile plumbing — same cache/manifest resolution and stats
+        # split (fresh compiles vs cache hits) as BucketScheduler
+        import jax
+        self._jax = jax
+        self._decode_jit = jax.jit(model.decode_fn(self.block_size),
+                                   donate_argnums=(0, 1))
+        self._prefill_jit = jax.jit(model.prefill_fn(self.block_size),
+                                    donate_argnums=(2, 3))
+        self._decode_exe = None
+        self._prefill_exes = {}
+        self._compiles = 0
+        self._cache_hits = 0
+        self._compile_seconds = 0.0
+        self._warmup_compiles = 0
+        self._compile_lock = threading.Lock()
+        if cache is None:
+            cache = default_cache()
+        self._cache = cache or None
+        if manifest is None:
+            self._manifest = (self._cache.manifest
+                              if self._cache is not None else None)
+        elif isinstance(manifest, str):
+            self._manifest = WarmupManifest(manifest)
+        else:
+            self._manifest = manifest or None
+        if warmup:
+            self.warmup()
+        self._worker = threading.Thread(
+            target=self._worker_loop, daemon=True,
+            name="veles-decode-%s" % name)
+        self._worker.start()
+
+    # -- compilation ---------------------------------------------------------
+    def _pool_structs(self):
+        return self._jax.tree_util.tree_map(
+            lambda a: self._jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (self._k_pools, self._v_pools))
+
+    def _aot(self, jitted, *structs, tag):
+        """AOT compile (through the persistent cache when active) with
+        the scheduler's compile accounting."""
+        t0 = time.perf_counter()
+        if self._cache is not None:
+            compiled, hit = self._cache.get_or_compile(
+                jitted, *structs, name="serving.%s.%s"
+                % (self.name, tag))
+        else:
+            compiled, hit = jitted.lower(*structs).compile(), None
+        dt = time.perf_counter() - t0
+        if hit:
+            self._cache_hits += 1
+        else:
+            self._compiles += 1
+        self._compile_seconds += dt
+        events.span("serving.compile", dt, model=self.name, bucket=tag,
+                    cache_hit=bool(hit) if hit is not None else None)
+        return compiled
+
+    def _get_decode_exe(self):
+        if self._decode_exe is None:
+            with self._compile_lock:
+                if self._decode_exe is None:
+                    jax = self._jax
+                    kps, vps = self._pool_structs()
+                    self._decode_exe = self._aot(
+                        self._decode_jit, kps, vps,
+                        jax.ShapeDtypeStruct(self._np_table.shape,
+                                             numpy.int32),
+                        jax.ShapeDtypeStruct((self.max_batch,),
+                                             numpy.int32),
+                        jax.ShapeDtypeStruct((self.max_batch,),
+                                             numpy.int32),
+                        tag="decode%d" % self.max_batch)
+                    if self._manifest is not None:
+                        self._manifest.record(self.name + "@decode",
+                                              self.max_batch)
+        return self._decode_exe
+
+    def _get_prefill_exe(self, bucket):
+        exe = self._prefill_exes.get(bucket)
+        if exe is None:
+            with self._compile_lock:
+                exe = self._prefill_exes.get(bucket)
+                if exe is None:
+                    jax = self._jax
+                    kps, vps = self._pool_structs()
+                    exe = self._aot(
+                        self._prefill_jit,
+                        jax.ShapeDtypeStruct((int(bucket),),
+                                             numpy.int32),
+                        jax.ShapeDtypeStruct((), numpy.int32),
+                        kps, vps,
+                        jax.ShapeDtypeStruct((self.max_blocks,),
+                                             numpy.int32),
+                        tag="prefill%d" % int(bucket))
+                    self._prefill_exes[bucket] = exe
+                    if self._manifest is not None:
+                        self._manifest.record(self.name + "@prefill",
+                                              bucket)
+        return exe
+
+    def _warmup_order(self):
+        order = list(self.prefill_buckets)
+        if self._manifest is None:
+            return order
+        first = [b for b in
+                 self._manifest.buckets(self.name + "@prefill")
+                 if b in order]
+        return first + [b for b in order if b not in first]
+
+    def warmup(self):
+        """Compile the decode step and the whole prefill ladder up
+        front (manifest-recorded buckets first) so steady state never
+        compiles."""
+        self._get_decode_exe()
+        for b in self._warmup_order():
+            self._get_prefill_exe(b)
+        self._warmup_compiles = self._compiles
+
+    # -- request side --------------------------------------------------------
+    def validate(self, prompt, max_new_tokens):
+        prompt = numpy.asarray(prompt)
+        if prompt.ndim != 1 or prompt.shape[0] < 1:
+            raise ValueError("prompt must be a non-empty 1-D token "
+                             "sequence")
+        if prompt.shape[0] > self.max_prompt_len:
+            raise ValueError(
+                "prompt of %d tokens exceeds max_prompt_len=%d"
+                % (prompt.shape[0], self.max_prompt_len))
+        if not numpy.issubdtype(prompt.dtype, numpy.integer):
+            if not numpy.all(prompt == prompt.astype(numpy.int64)):
+                raise ValueError("prompt tokens must be integers")
+        prompt = prompt.astype(numpy.int32)
+        vocab = getattr(self.model, "vocab", None)
+        if vocab and (prompt.min() < 0 or prompt.max() >= vocab):
+            raise ValueError("prompt tokens outside [0, %d)" % vocab)
+        if not 1 <= int(max_new_tokens) <= self.max_new_tokens:
+            raise ValueError(
+                "max_new_tokens must be in [1, %d], got %r"
+                % (self.max_new_tokens, max_new_tokens))
+        return prompt
+
+    def submit(self, prompt, max_new_tokens=None):
+        """Enqueue one generate request → Future of
+        ``{"tokens": [...], "ttft_s": float, "prompt_tokens": n}``.
+        Raises SchedulerOverflow / SchedulerClosed / ValueError."""
+        if max_new_tokens is None:
+            max_new_tokens = self.max_new_tokens
+        prompt = self.validate(prompt, max_new_tokens)
+        if self._closed:
+            raise SchedulerClosed("decode scheduler %r is draining"
+                                  % self.name)
+        with self._depth_lock:
+            if self._depth >= self.queue_limit:
+                self.metrics.record_reject()
+                raise SchedulerOverflow(
+                    "decode queue full (%d outstanding, limit %d)"
+                    % (self._depth, self.queue_limit))
+            self._depth += 1
+        req = _Request(prompt, max_new_tokens)
+        self._queue.put(req)
+        return req.future
+
+    def generate(self, prompt, max_new_tokens=None, timeout=None):
+        """Blocking :meth:`submit`."""
+        return self.submit(prompt, max_new_tokens).result(timeout)
+
+    # -- worker --------------------------------------------------------------
+    def _worker_loop(self):
+        stop = False
+        while True:
+            block = not self._sessions and not self._pending and not stop
+            while True:
+                try:
+                    item = self._queue.get(block=block, timeout=None) \
+                        if block else self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                block = False
+                if item is _STOP:
+                    stop = True
+                    break
+                self._pending.append(item)
+            if self._abort:
+                self._cancel_all()
+                return
+            self._admit()
+            if self._sessions:
+                self._step()
+            elif stop and not self._pending:
+                return
+
+    def _fail(self, req, exc):
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_exception(exc)
+        self._release()
+
+    def _release(self):
+        with self._depth_lock:
+            self._depth -= 1
+
+    def _cancel_all(self):
+        exc = SchedulerClosed("scheduler shut down")
+        while self._pending:
+            self._fail(self._pending.popleft(), exc)
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                self._fail(item, exc)
+        for row in list(self._sessions):
+            session = self._sessions[row]
+            self._retire(session, error=exc)
+
+    # -- admission / prefill -------------------------------------------------
+    def _free_rows(self):
+        return [r for r in range(self.max_batch)
+                if r not in self._sessions]
+
+    def _admit(self):
+        rows = self._free_rows()
+        while self._pending and rows:
+            req = self._pending[0]
+            need = required_blocks(
+                len(req.prompt) + req.max_new_tokens, self.block_size)
+            blocks = self._pool.alloc(need)
+            if blocks is None:
+                break               # head-of-line waits for retirements
+            self._pending.popleft()
+            row = rows.pop(0)
+            session = _Session(req, row, blocks)
+            try:
+                self._prefill(session)
+            except Exception as exc:  # noqa: BLE001 — fail THIS request
+                self._pool.free(blocks)
+                self._np_table[row] = 0
+                self._fail(req, exc)
+                rows.insert(0, row)
+                continue
+            self._sessions[row] = session
+            self.metrics.record_admit(len(req.prompt))
+            if session.done:        # max_new_tokens == 1: prefill was all
+                self._retire(session)
+                rows.insert(0, row)
+        self.metrics.set_occupancy(
+            len(self._sessions), self._pool.live_blocks /
+            max(self._pool.capacity, 1))
+
+    def _prefill(self, session):
+        req = session.req
+        length = len(req.prompt)
+        bucket = next(b for b in self.prefill_buckets if b >= length)
+        run = self._get_prefill_exe(bucket)
+        tokens = numpy.zeros(bucket, numpy.int32)
+        tokens[:length] = req.prompt
+        block_row = numpy.zeros(self.max_blocks, numpy.int32)
+        block_row[:len(session.blocks)] = session.blocks
+        t0 = time.perf_counter()
+        first, self._k_pools, self._v_pools = run(
+            tokens, numpy.int32(length), self._k_pools, self._v_pools,
+            block_row)
+        first = int(first)
+        dt = time.perf_counter() - t0
+        session.length = length
+        session.next_input = first
+        session.generated.append(first)
+        session.first_token_s = time.perf_counter() - req.enqueued
+        self._np_table[session.row, :] = 0
+        self._np_table[session.row, :len(session.blocks)] = \
+            session.blocks
+        self._np_lengths[session.row] = length
+        self._np_tokens[session.row] = first
+        self.metrics.record_first_token(session.first_token_s)
+        events.span("serving.prefill", dt, model=self.name,
+                    bucket=int(bucket), prompt_tokens=int(length))
+
+    # -- the per-token step --------------------------------------------------
+    def _step(self):
+        run = self._get_decode_exe()
+        t0 = time.perf_counter()
+        next_tokens, self._k_pools, self._v_pools = run(
+            self._k_pools, self._v_pools, self._np_table,
+            self._np_lengths, self._np_tokens)
+        next_tokens = numpy.asarray(next_tokens)     # D2H sync point
+        dt = time.perf_counter() - t0
+        active = list(self._sessions.values())
+        for session in active:
+            token = int(next_tokens[session.row])
+            session.length += 1              # the fed token is now cached
+            session.generated.append(token)
+            session.next_input = token
+            self._np_lengths[session.row] = session.length
+            self._np_tokens[session.row] = token
+            if session.done:
+                self._retire(session)
+        self.metrics.record_step(len(active), self.max_batch, dt)
+
+    def _retire(self, session, error=None):
+        self._sessions.pop(session.row, None)
+        self._pool.free(session.blocks)
+        self._np_table[session.row, :] = 0
+        self._np_lengths[session.row] = 0
+        self._np_tokens[session.row] = 0
+        future = session.req.future
+        if error is not None:
+            self.metrics.record_complete(len(session.generated),
+                                         ok=False)
+            if future.set_running_or_notify_cancel():
+                future.set_exception(error)
+        else:
+            self.metrics.record_complete(len(session.generated))
+            if future.set_running_or_notify_cancel():
+                future.set_result({
+                    "tokens": [int(t) for t in session.generated],
+                    "prompt_tokens": len(session.req.prompt),
+                    "ttft_s": round(session.first_token_s, 6),
+                })
+        self._release()
+
+    # -- lifecycle / introspection -------------------------------------------
+    def close(self, drain=True, timeout=30.0):
+        """Stop accepting; with ``drain`` every already-submitted
+        request finishes (admitted sequences run out, queued ones still
+        get admitted as rows free), else cancel everything."""
+        if self._closed:
+            return
+        self._closed = True
+        if not drain:
+            self._abort = True
+        self._queue.put(_STOP)
+        self._worker.join(timeout)
+        # late racers that slipped past the closed flag
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                self._fail(item, SchedulerClosed("scheduler shut down"))
+
+    @property
+    def queue_depth(self):
+        return self._depth
+
+    @property
+    def active_sequences(self):
+        return len(self._sessions)
+
+    def stats(self):
+        """Zero-recompile evidence + occupancy, BucketScheduler-shaped
+        (``compiles`` = fresh XLA only; warm restarts show 0)."""
+        pool = self._pool.stats()
+        return {
+            "buckets": list(self.prefill_buckets),
+            "executables": (1 if self._decode_exe is not None else 0)
+            + len(self._prefill_exes),
+            "compiles": self._compiles,
+            "cache_hits": self._cache_hits,
+            "compile_seconds": round(self._compile_seconds, 4),
+            "warmup_compiles": self._warmup_compiles,
+            "post_warmup_compiles": self._compiles -
+            self._warmup_compiles,
+            "queue_depth": self._depth,
+            "queue_limit": self.queue_limit,
+            "max_batch": self.max_batch,
+            "active_sequences": len(self._sessions),
+            "block_size": self.block_size,
+            "num_blocks": pool["num_blocks"],
+            "free_blocks": pool["free_blocks"],
+            "kv_utilization": pool["utilization"],
+            "max_prompt_len": self.max_prompt_len,
+            "max_new_tokens": self.max_new_tokens,
+            "closed": self._closed,
+        }

@@ -1,0 +1,97 @@
+"""Host-side block accounting for the paged KV cache.
+
+The device side is dumb on purpose — two preallocated pool arrays per
+layer ([num_blocks, block_size, heads, head_dim]) that the decode
+executable scatters into and the ragged paged-attention kernel gathers
+from (znicz/paged_attention.py).  ALL placement policy lives here, on
+the host, as plain integers: a free-list of physical block ids and one
+page-table row per live sequence.  Admitting a sequence is a list pop,
+retiring is a list push — no device traffic, no recompilation, which is
+the entire point of paging (PAPERS.md "Ragged Paged Attention" /
+vLLM's PagedAttention block tables).
+
+Physical block 0 is reserved as the **trash block**: padding rows of
+the page table point at it, masked-out prefill positions scatter into
+it, and it is never handed to a live sequence — so a stray write can
+only ever land somewhere no real sequence reads (the isolation property
+tests/test_decode_serving.py asserts over random admit/retire
+schedules).
+"""
+
+__all__ = ["KVBlockPool", "required_blocks"]
+
+
+def required_blocks(tokens, block_size):
+    """Blocks a sequence of ``tokens`` total tokens occupies."""
+    return -(-int(tokens) // int(block_size))
+
+
+class KVBlockPool:
+    """Free-list allocator over ``num_blocks`` physical blocks.
+
+    Not thread-safe by itself — the decode scheduler's single worker
+    thread owns it (the same discipline the device pools get for free
+    from executable ordering).
+    """
+
+    TRASH = 0           # reserved physical block — never allocated
+
+    def __init__(self, num_blocks, block_size):
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        if self.num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is reserved)")
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        # LIFO: recently-freed blocks are reused first (warm in cache)
+        self._free = list(range(self.num_blocks - 1, self.TRASH, -1))
+        self._live = set()
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    @property
+    def live_blocks(self):
+        return len(self._live)
+
+    @property
+    def capacity(self):
+        """Allocatable blocks (total minus the reserved trash block)."""
+        return self.num_blocks - 1
+
+    def fits(self, tokens):
+        """Whether a sequence of ``tokens`` total tokens can ever fit."""
+        return required_blocks(tokens, self.block_size) <= self.capacity
+
+    def alloc(self, n):
+        """Pop ``n`` blocks, or None (allocation is all-or-nothing —
+        a partial grab would deadlock two half-admitted sequences)."""
+        n = int(n)
+        if n < 1:
+            raise ValueError("alloc of %d blocks" % n)
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._live.update(blocks)
+        return blocks
+
+    def free(self, blocks):
+        """Return a retired sequence's blocks to the free list."""
+        for b in blocks:
+            b = int(b)
+            if b == self.TRASH:
+                raise ValueError("block 0 is reserved; it was never "
+                                 "allocated")
+            if b not in self._live:
+                raise ValueError("double free of block %d" % b)
+            self._live.discard(b)
+            self._free.append(b)
+
+    def stats(self):
+        return {"num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "free_blocks": self.free_blocks,
+                "live_blocks": self.live_blocks,
+                "utilization": round(
+                    self.live_blocks / max(self.capacity, 1), 4)}

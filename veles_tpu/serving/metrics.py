@@ -64,6 +64,20 @@ class LatencyWindow:
                 "max_ms": to_ms(ordered[-1])}
 
 
+def _bind_counters(registry, model, spec):
+    """Declare (idempotently) and label-bind one counter child per
+    ``spec`` entry.  EVERY serving metrics class — request-granularity
+    :class:`ServingMetrics` and token-level :class:`DecodeMetrics`
+    alike — binds through here, so running both scheduler kinds in one
+    process re-declares the same families instead of colliding, and a
+    second same-named scheduler (hot swap) reuses the existing series.
+    Returns ({key: child}, {key: construction-baseline value})."""
+    children = {key: registry.counter(name, help, ("model",))
+                .labels(model=model)
+                for key, (name, help) in spec.items()}
+    return children, {key: child.value for key, child in children.items()}
+
+
 #: registry counter families shared by every ServingMetrics instance
 _COUNTERS = {
     "requests": ("veles_serving_requests_total",
@@ -105,13 +119,11 @@ class ServingMetrics:
         self.latency = LatencyWindow()
         self._lock = threading.Lock()
         self._t0 = time.time()
-        self._c = {key: self.registry.counter(name, help, ("model",))
-                   .labels(model=model)
-                   for key, (name, help) in _COUNTERS.items()}
         # baseline at construction: the registry series are process-
         # global and monotonic (Prometheus semantics); snapshot() is
         # per-instance, so it reads deltas from here
-        self._base = {key: child.value for key, child in self._c.items()}
+        self._c, self._base = _bind_counters(self.registry, model,
+                                             _COUNTERS)
         self._h_latency = self.registry.histogram(
             "veles_serving_request_seconds",
             "End-to-end request latency", ("model",)).labels(model=model)
@@ -221,5 +233,154 @@ class ServingMetrics:
             "rows_per_batch": round(filled / counters["batches"], 2)
             if counters["batches"] else None,
             "latency": self.latency.summary(),
+        })
+        return out
+
+
+#: registry counter families shared by every DecodeMetrics instance
+_DECODE_COUNTERS = {
+    "sequences": ("veles_serving_decode_sequences_total",
+                  "Sequences admitted to the decode scheduler"),
+    "completed": ("veles_serving_decode_completed_total",
+                  "Sequences that finished generation"),
+    "failed": ("veles_serving_decode_failed_total",
+               "Sequences failed or cancelled before finishing"),
+    "rejected": ("veles_serving_decode_rejected_total",
+                 "Generate requests shed by backpressure (HTTP 429)"),
+    "tokens": ("veles_serving_decode_tokens_total",
+               "Tokens generated (prefill first-tokens included)"),
+    "prefill_tokens": ("veles_serving_decode_prefill_tokens_total",
+                       "Prompt tokens processed by prefill"),
+    "steps": ("veles_serving_decode_steps_total",
+              "Decode-step executions"),
+    "step_rows": ("veles_serving_decode_step_rows_total",
+                  "Active rows across decode steps (sum)"),
+    "idle_rows": ("veles_serving_decode_idle_rows_total",
+                  "Padding rows across decode steps (sum) — the "
+                  "utilization the request-granularity path wastes"),
+}
+
+
+class DecodeMetrics:
+    """Per-model counters for the token-level decode scheduler.
+
+    Same construction/baseline discipline as :class:`ServingMetrics`
+    (shared :func:`_bind_counters` declaration path — both scheduler
+    kinds can run in one process, or hot-swap under one name, without
+    double-declaring a registry family), plus the decode-shaped
+    signals: per-step latency quantiles (≈ inter-token latency),
+    time-to-first-token, batch-row utilization, and KV-block occupancy.
+    """
+
+    RATE_WINDOW = 4096  # (timestamp, tokens) pairs for the recent view
+
+    def __init__(self, model="default", registry=None):
+        self.model = model
+        self.registry = registry or REGISTRY
+        self.step_latency = LatencyWindow()
+        self.ttft = LatencyWindow()
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+        self._c, self._base = _bind_counters(self.registry, model,
+                                             _DECODE_COUNTERS)
+        self._h_step = self.registry.histogram(
+            "veles_serving_decode_step_seconds",
+            "Decode step wall time (≈ per-token latency under load)",
+            ("model",)).labels(model=model)
+        self._h_ttft = self.registry.histogram(
+            "veles_serving_decode_ttft_seconds",
+            "Submit-to-first-token latency (queue + prefill)",
+            ("model",)).labels(model=model)
+        self._g_active = self.registry.gauge(
+            "veles_serving_decode_active_rows",
+            "Sequences currently decoding", ("model",)).labels(
+                model=model)
+        self._g_kv = self.registry.gauge(
+            "veles_serving_kv_blocks_used_ratio",
+            "Live KV blocks / allocatable blocks", ("model",)).labels(
+                model=model)
+        self._g_quantile = self.registry.gauge(
+            "veles_serving_decode_step_quantile_ms",
+            "Exact decode-step quantiles over the recent window",
+            ("model", "quantile"))
+        self.registry.register_collector(self)
+        self._emissions = collections.deque(maxlen=self.RATE_WINDOW)
+
+    def _count(self, key):
+        return int(round(self._c[key].value - self._base[key]))
+
+    def __getattr__(self, name):
+        if name in _DECODE_COUNTERS:
+            return self._count(name)
+        raise AttributeError(name)
+
+    # -- recording (scheduler worker thread) ---------------------------------
+    def record_admit(self, prompt_tokens):
+        self._c["sequences"].inc()
+        self._c["prefill_tokens"].inc(int(prompt_tokens))
+
+    def record_first_token(self, seconds):
+        """TTFT for one sequence: submit -> prefill's first token."""
+        self.ttft.record(seconds)
+        self._h_ttft.observe(seconds)
+        self._c["tokens"].inc()
+        with self._lock:
+            self._emissions.append((time.time(), 1))
+
+    def record_step(self, active_rows, max_rows, seconds):
+        self.step_latency.record(seconds)
+        self._h_step.observe(seconds)
+        self._c["steps"].inc()
+        self._c["step_rows"].inc(int(active_rows))
+        self._c["idle_rows"].inc(int(max_rows) - int(active_rows))
+        self._c["tokens"].inc(int(active_rows))
+        with self._lock:
+            self._emissions.append((time.time(), int(active_rows)))
+        events.span("serving.decode", seconds, model=self.model,
+                    rows=int(active_rows), max_rows=int(max_rows))
+
+    def record_complete(self, generated, ok=True):
+        self._c["completed" if ok else "failed"].inc()
+
+    def record_reject(self):
+        self._c["rejected"].inc()
+        events.event("serving.decode_reject", model=self.model)
+
+    def set_occupancy(self, active_rows, kv_ratio):
+        self._g_active.set(int(active_rows))
+        self._g_kv.set(float(kv_ratio))
+
+    def collect_metrics(self):
+        """Scrape-time refresh of the derived quantile gauges."""
+        s = self.step_latency.summary()
+        for q in ("p50", "p95", "p99"):
+            value = s.get("%s_ms" % q)
+            if value is not None:
+                self._g_quantile.labels(model=self.model,
+                                        quantile=q).set(value)
+
+    # -- reader --------------------------------------------------------------
+    def snapshot(self):
+        now = time.time()
+        with self._lock:
+            emissions = list(self._emissions)
+        counters = {key: self._count(key) for key in _DECODE_COUNTERS}
+        uptime = max(now - self._t0, 1e-9)
+        recent_tok_s = None
+        if len(emissions) >= 2:
+            span = emissions[-1][0] - emissions[0][0]
+            if span > 0:
+                recent_tok_s = round(
+                    sum(n for _, n in emissions[1:]) / span, 1)
+        rows = counters["step_rows"] + counters["idle_rows"]
+        out = dict(counters)
+        out.update({
+            "uptime_s": round(uptime, 1),
+            "lifetime_tok_s": round(counters["tokens"] / uptime, 2),
+            "recent_tok_s": recent_tok_s,
+            "row_fill": round(counters["step_rows"] / rows, 4)
+            if rows else None,
+            "step_latency": self.step_latency.summary(),
+            "ttft": self.ttft.summary(),
         })
         return out

@@ -11,7 +11,8 @@ explicitly flagged — entry as the default for bare ``POST /api``.
 import threading
 import time
 
-from .metrics import ServingMetrics
+from .decode import DecodeScheduler
+from .metrics import DecodeMetrics, ServingMetrics
 from .scheduler import BucketScheduler
 
 
@@ -52,8 +53,47 @@ class ServedModel:
                 "queue_limit": stats["queue_limit"]}
 
 
+class DecodeServedModel:
+    """A registry entry for the token-level decode path: a
+    :class:`~veles_tpu.serving.decode.DecodeScheduler` behind the
+    generate-style endpoint (``POST /api/<name>/generate``)."""
+
+    kind = "decode"
+
+    def __init__(self, name, scheduler, source=None):
+        self.name = name
+        self.scheduler = scheduler
+        self.source = source
+        self.created = time.time()
+
+    def generate(self, prompt, max_new_tokens=None, timeout=None):
+        """→ the result dict (tokens, ttft_s, prompt_tokens)."""
+        return self.scheduler.generate(prompt, max_new_tokens,
+                                       timeout=timeout)
+
+    def describe(self):
+        stats = self.scheduler.stats()
+        return {"source": self.source,
+                "kind": "decode",
+                "max_prompt_len": stats["max_prompt_len"],
+                "max_new_tokens": stats["max_new_tokens"],
+                "max_batch": stats["max_batch"],
+                "block_size": stats["block_size"],
+                "num_blocks": stats["num_blocks"],
+                "active_sequences": stats["active_sequences"],
+                "queue_depth": stats["queue_depth"],
+                "queue_limit": stats["queue_limit"]}
+
+
+def _is_decode_model(model):
+    """A decode adapter exposes the prefill/decode closure pair."""
+    return (hasattr(model, "decode_fn") and hasattr(model, "prefill_fn")
+            and hasattr(model, "make_pools"))
+
+
 class ModelRegistry:
-    """Thread-safe name → :class:`ServedModel` map."""
+    """Thread-safe name → :class:`ServedModel` /
+    :class:`DecodeServedModel` map."""
 
     def __init__(self, **scheduler_defaults):
         self._models = {}
@@ -66,7 +106,12 @@ class ModelRegistry:
             metrics=None, **scheduler_kwargs):
         """Register a model (workflow / package path / PackageLoader /
         callable) under ``name``; compiles its bucket ladder now so the
-        first request is already warm."""
+        first request is already warm.  A decode adapter (anything with
+        the ``prefill_fn``/``decode_fn``/``make_pools`` trio) routes to
+        :meth:`add_decode` instead."""
+        if _is_decode_model(model):
+            return self.add_decode(name, model, default=default,
+                                   metrics=metrics, **scheduler_kwargs)
         source = model if isinstance(model, str) else type(model).__name__
         kwargs = dict(self._scheduler_defaults)
         kwargs.update(scheduler_kwargs)
@@ -75,6 +120,30 @@ class ModelRegistry:
             metrics=metrics or ServingMetrics(name), **kwargs)
         entry = ServedModel(name, scheduler, transform=transform,
                             source=source)
+        return self._install(name, entry, default)
+
+    def add_decode(self, name, model, default=False, metrics=None,
+                   **decode_kwargs):
+        """Register a decode adapter under ``name`` — warms its decode
+        executable and prefill ladder now, serves
+        ``POST /api/<name>/generate``."""
+        # registry-wide defaults may mix bucket- and decode-scheduler
+        # knobs (one server can host both kinds); forward only what
+        # DecodeScheduler actually takes
+        kwargs = {k: v for k, v in self._scheduler_defaults.items()
+                  if k in ("max_batch", "block_size", "max_prompt_len",
+                           "max_new_tokens", "num_blocks",
+                           "queue_limit", "cache", "manifest",
+                           "warmup")}
+        kwargs.update(decode_kwargs)
+        scheduler = DecodeScheduler(
+            model, name=name,
+            metrics=metrics or DecodeMetrics(name), **kwargs)
+        entry = DecodeServedModel(name, scheduler,
+                                  source=type(model).__name__)
+        return self._install(name, entry, default)
+
+    def _install(self, name, entry, default):
         with self._lock:
             prior = self._models.get(name)
             self._models[name] = entry

@@ -16,8 +16,10 @@ connect + thread-spawn per request (measured 40 ms delayed-ACK stalls
 without ``TCP_NODELAY`` on loopback).
 
 Endpoints:
-    POST /api            infer on the default model
-    POST /api/<model>    infer on a named model
+    POST /api                      infer on the default model
+    POST /api/<model>              infer on a named model
+    POST /api/<model>/generate     autoregressive decode (token-level
+                                   continuous batching; decode models)
     GET  /healthz        liveness + model listing
     GET  /metrics        per-model latency/throughput/batching snapshot
     GET  /models         registry description
@@ -26,6 +28,7 @@ Shutdown is a graceful drain: stop accepting, finish every queued
 request, then stop the dispatch workers.
 """
 
+import json
 import logging
 import threading
 import time
@@ -54,7 +57,12 @@ class _ServingHandler(JsonRequestHandler):
             self.send_json(404, {"error": "not found"})
             return
         name = path[len("/api/"):] if path.startswith("/api/") else None
-        self._infer(name)
+        if name and name.endswith("/generate"):
+            self._generate(name[:-len("/generate")] or None)
+        elif name == "generate":
+            self._generate(None)
+        else:
+            self._infer(name)
 
     def do_GET(self):
         srv = self.server_ref
@@ -131,6 +139,87 @@ class _ServingHandler(JsonRequestHandler):
                        headers=trace_hdr)
         return 200
 
+    # -- the decode path -----------------------------------------------------
+    def _generate(self, name):
+        with _trace.span_context(
+                trace_id=self.headers.get("X-Trace-Id") or None) as ctx:
+            t0 = time.perf_counter()
+            status = self._generate_traced(name, ctx)
+            events.span("serving.generate_request",
+                        time.perf_counter() - t0,
+                        model=name or "<default>", status=status)
+
+    def _read_generate_payload(self):
+        """{"prompt": [...], "max_new_tokens": n?} → (prompt, n)."""
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            payload = json.loads(self.rfile.read(length))
+        except ValueError:
+            raise ClientError("body is not valid JSON")
+        if not isinstance(payload, dict) or "prompt" not in payload:
+            raise ClientError(
+                "body must be {'prompt': [tokens], "
+                "'max_new_tokens': n?}")
+        max_new = payload.get("max_new_tokens")
+        if max_new is not None and not isinstance(max_new, int):
+            raise ClientError("'max_new_tokens' must be an integer")
+        return payload["prompt"], max_new
+
+    def _generate_traced(self, name, ctx):
+        srv = self.server_ref
+        entry = srv.registry.resolve(name)
+        trace_hdr = {"X-Trace-Id": ctx.trace_id}
+        try:
+            prompt, max_new = self._read_generate_payload()
+            if entry is None:
+                self.send_json(404, {
+                    "error": "unknown model %r" % (name or "<default>"),
+                    "models": srv.registry.names()}, headers=trace_hdr)
+                return 404
+            if not hasattr(entry, "generate"):
+                self.send_json(400, {
+                    "error": "model %r is not a decode model; use "
+                             "POST /api/%s" % (entry.name, entry.name)},
+                    headers=trace_hdr)
+                return 400
+            entry.scheduler.validate(
+                prompt, max_new if max_new is not None
+                else entry.scheduler.max_new_tokens)
+        except ClientError as e:
+            self.send_json(400, {"error": str(e)}, headers=trace_hdr)
+            return 400
+        except (ValueError, TypeError) as e:
+            self.send_json(400, {"error": str(e)}, headers=trace_hdr)
+            return 400
+        try:
+            result = entry.generate(prompt, max_new,
+                                    timeout=srv.request_timeout)
+        except SchedulerOverflow as e:
+            self.send_json(429, {"error": "server overloaded: %s" % e,
+                                 "model": entry.name},
+                           headers={"Retry-After": "1", **trace_hdr})
+            return 429
+        except SchedulerClosed:
+            # drain: in-flight sequences finish, NEW generate submits
+            # shed with retryable backpressure (429 + Retry-After), so
+            # a well-behaved client re-resolves to another replica
+            self.send_json(429, {"error": "server is draining",
+                                 "model": entry.name},
+                           headers={"Retry-After": "1",
+                                    "Connection": "close", **trace_hdr})
+            return 429
+        except Exception:
+            error_id = uuid.uuid4().hex[:12]
+            log.exception("generate failed on model %r (error id %s)",
+                          entry.name, error_id)
+            self.send_json(500, {"error": "internal inference error",
+                                 "model": entry.name, "id": error_id},
+                           headers=trace_hdr)
+            return 500
+        self.send_json(200, dict(result, model=entry.name),
+                       headers=trace_hdr)
+        return 200
+
 
 class InferenceServer:
     """Serve one or more models over HTTP with dynamic batching.
@@ -173,8 +262,15 @@ class InferenceServer:
         return self.registry.add(name, model, **kwargs)
 
     def stop(self, drain=True):
-        """Graceful shutdown: stop accepting, drain the queues, stop."""
+        """Graceful shutdown: mark draining, finish every admitted
+        request/sequence, then stop the HTTP front end.
+
+        The schedulers close FIRST (while the HTTP listener still
+        answers), so a request arriving mid-drain gets a structured
+        shed — 429 + Retry-After on the generate route, 503 on the
+        classic route — instead of a connection reset; only after every
+        queue drains does the listener go away."""
         self.draining = True
-        self._httpd.shutdown()
         self.registry.close(drain=drain)
+        self._httpd.shutdown()
         self._httpd.server_close()

@@ -1,0 +1,214 @@
+"""Ragged paged decode attention as a Pallas TPU kernel.
+
+The decode-serving counterpart of :mod:`.flash_attention` (PAPERS.md
+"Ragged Paged Attention", arXiv 2604.15464): at decode time every
+sequence contributes ONE query token, but its K/V history lives in
+fixed-size blocks scattered across a preallocated device pool — the
+page table (``[B, max_blocks]`` physical block ids) and the per-sequence
+lengths are the only things that change shape-free from step to step,
+so one compiled kernel serves ANY mix of sequence lengths with zero
+recompilation.  That is what makes token-level continuous batching
+(serving/decode.py) possible: admitting or retiring a sequence edits
+the page table, never the executable.
+
+Kernel structure — two sweeps over the inner block grid, page-table
+indirection via scalar prefetch (the index map reads the prefetched
+page table to pick which PHYSICAL pool block the next DMA fetches, the
+canonical TPU paged-attention gather):
+
+- sweep 1 streams the sequence's K blocks, scoring each against the
+  query and materializing the per-sequence score row in VMEM scratch
+  (decode scores are [1, T] — tiny, unlike the [T, T] training case);
+- the boundary step normalizes: one max, one exp, one sum — a DENSE
+  softmax over the scratch row, not an online rescale;
+- sweep 2 streams the V blocks, accumulating the probability-weighted
+  sum block by block.
+
+K and V each cross HBM exactly once (same DMA bill as a fused single
+sweep), and because the softmax is dense the kernel is **bitwise equal
+to the dense reference** — no online-softmax rescale drift — which is
+what the tier-1 parity tests assert (interpret mode on CPU, compiled on
+TPU).  Blocks past a sequence's length are skipped entirely: compute
+AND DMA stay O(length), so a ragged batch costs its true token count,
+not ``B * max_context``.
+
+Padding rows (``length == 0``) return zeros; padding page-table entries
+must point at physical block 0, which the serving pool reserves as the
+trash block (never allocated to a live sequence).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["paged_attention", "paged_attention_reference",
+           "required_blocks"]
+
+_NEG_INF = float("-inf")
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def required_blocks(length, block_size):
+    """Pool blocks a sequence of ``length`` tokens occupies."""
+    return -(-int(length) // int(block_size))
+
+
+def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   s_scr, m_scr, l_scr, acc_scr, *, block_size,
+                   n_blocks, scale):
+    from jax.experimental import pallas as pl
+
+    b, j = pl.program_id(0), pl.program_id(2)
+    length = len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        s_scr[...] = jnp.full_like(s_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # -- sweep 1 (j < n_blocks): score K blocks into the scratch row ---------
+    @pl.when(jnp.logical_and(j < n_blocks, j * block_size < length))
+    def _score():
+        q = q_ref[0, 0].astype(jnp.float32) * scale       # [D]
+        kb = k_ref[0, :, 0].astype(jnp.float32)           # [bs, D]
+        s = jnp.sum(q[None, :] * kb, axis=-1)             # [bs]
+        pos = j * block_size + lax.broadcasted_iota(
+            jnp.int32, (block_size, 1), 0)[:, 0]
+        s = jnp.where(pos < length, s, _NEG_INF)
+        s_scr[j] = s
+        m_scr[0, 0] = jnp.maximum(m_scr[0, 0], jnp.max(s))
+
+    # -- boundary: dense softmax over the whole scratch row ------------------
+    @pl.when(j == n_blocks)
+    def _normalize():
+        m = m_scr[0, 0]
+        safe_m = jnp.where(jnp.isneginf(m), 0.0, m)
+        p = jnp.where(jnp.isneginf(s_scr[...]), 0.0,
+                      jnp.exp(s_scr[...] - safe_m))
+        s_scr[...] = p
+        l_scr[0, 0] = jnp.sum(p)
+
+    # -- sweep 2 (j >= n_blocks): weighted V accumulation --------------------
+    jv = j - n_blocks
+
+    @pl.when(jnp.logical_and(j >= n_blocks, jv * block_size < length))
+    def _accumulate():
+        vb = v_ref[0, :, 0].astype(jnp.float32)           # [bs, D]
+        p = s_scr[jv]                                     # [bs]
+        acc_scr[...] = acc_scr[...] + jnp.sum(
+            p[:, None] * vb, axis=0, keepdims=True)
+
+    @pl.when(j == 2 * n_blocks - 1)
+    def _finish():
+        l = l_scr[0, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[0] / safe_l).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, page_table, lengths, scale=None):
+    """Ragged paged decode attention.
+
+    ``q``: [B, H, D] — one query token per sequence;
+    ``k_pool``/``v_pool``: [num_blocks, block_size, H, D] — the shared
+    physical block pools;
+    ``page_table``: int32 [B, max_blocks] — physical block id of each
+    sequence's logical block, padded with 0 (the reserved trash block);
+    ``lengths``: int32 [B] — valid tokens per sequence (0 = padding
+    row, returns zeros).
+
+    Returns [B, H, D].  Compiled once per (B, H, D, block_size,
+    max_blocks) — sequence lengths and table contents are runtime data.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    n_pool, bs, hp, dp = k_pool.shape
+    if v_pool.shape != k_pool.shape:
+        raise ValueError("k_pool and v_pool shapes differ: %r vs %r"
+                         % (k_pool.shape, v_pool.shape))
+    if (hp, dp) != (h, d):
+        raise ValueError("pool head layout %r does not match q %r"
+                         % ((hp, dp), (h, d)))
+    nb = page_table.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    kernel = functools.partial(_decode_kernel, block_size=bs,
+                               n_blocks=nb, scale=float(scale))
+    # index maps see the prefetched page table: sweep 1 follows it for
+    # K, sweep 2 for V; the off-sweep operand pins to an already-mapped
+    # block (clipped id) so no DMA reads out of range
+    k_index = lambda b_, h_, j, pt, ln: (  # noqa: E731
+        pt[b_, jnp.minimum(j, nb - 1)], 0, h_, 0)
+    v_index = lambda b_, h_, j, pt, ln: (  # noqa: E731
+        pt[b_, jnp.clip(j - nb, 0, nb - 1)], 0, h_, 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, 2 * nb),
+        in_specs=[
+            pl.BlockSpec((1, 1, d),
+                         lambda b_, h_, j, pt, ln: (b_, h_, 0)),
+            pl.BlockSpec((1, bs, 1, d), k_index),
+            pl.BlockSpec((1, bs, 1, d), v_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d),
+                               lambda b_, h_, j, pt, ln: (b_, h_, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nb, bs), jnp.float32),    # score / prob row
+            pltpu.VMEM((1, 1), jnp.float32),      # running max
+            pltpu.VMEM((1, 1), jnp.float32),      # softmax denominator
+            pltpu.VMEM((1, d), jnp.float32),      # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=_interpret(),
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+def paged_attention_reference(q, k_pool, v_pool, page_table, lengths,
+                              scale=None):
+    """Pure-jnp dense oracle: gather every sequence's blocks into a
+    dense [B, T_max, H, D] view, materialize the full score row, dense
+    softmax, weighted sum.
+
+    The reductions are staged the way the kernel streams (per-block
+    partial sums, then a sequential accumulation over the block axis)
+    so the parity tests can assert BITWISE equality, not just
+    tolerance — float addition is non-associative, and XLA's fused
+    reduce over the block axis associates differently than the
+    kernel's block-sequential accumulator.
+    """
+    b, h, d = q.shape
+    n_pool, bs, hp, dp = k_pool.shape
+    nb = page_table.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    k = k_pool[page_table]                      # [B, nb, bs, H, D]
+    v = v_pool[page_table]
+    qf = q.astype(jnp.float32) * scale
+    s = jnp.sum(k.astype(jnp.float32) * qf[:, None, None], axis=-1)
+    s = jnp.moveaxis(s, 3, 1)                   # [B, H, nb, bs]
+    pos = (jnp.arange(nb)[:, None] * bs +
+           jnp.arange(bs)[None, :])             # [nb, bs]
+    valid = pos[None, None] < lengths[:, None, None, None]
+    s = jnp.where(valid, s, _NEG_INF)
+    m = jnp.max(s, axis=(2, 3), keepdims=True)
+    safe_m = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - safe_m))
+    l = jnp.sum(p, axis=(2, 3))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    vm = jnp.moveaxis(v.astype(jnp.float32), 3, 1)   # [B, H, nb, bs, D]
+    pv = jnp.sum(p[..., None] * vm, axis=3)          # [B, H, nb, D]
+    o = pv[:, :, 0]
+    for j in range(1, nb):                      # block-sequential, like
+        o = o + pv[:, :, j]                     # the kernel's sweep 2
+    return (o / safe_l[..., None]).astype(q.dtype)

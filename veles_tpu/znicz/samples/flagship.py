@@ -207,6 +207,221 @@ def demo_mesh():
     return make_mesh({"data": 2, "pipe": 2, "expert": 2})
 
 
+# -- causal decode over a paged KV cache --------------------------------------
+#
+# The serving-side face of the flagship model (ISSUE 6): a tied
+# token embedding turns the [B, T, D] -> [B, T, D] trainer into a
+# generate-style language model, and the per-layer K/V of every served
+# sequence lives in the serving pool's fixed-size blocks
+# (znicz.paged_attention) instead of a rectangular [B, T_max] cache.
+# ``prefill`` runs the prompt through the dense causal forward ONCE
+# while writing its K/V into the sequence's pool blocks;
+# ``decode_step`` is the single-token iteration the token-level
+# scheduler (serving/decode.py) compiles to ONE warm executable:
+# [max_batch] token rows + the page-table operand, any mix of
+# per-sequence lengths, zero steady-state recompiles.
+#
+# MoE routing at decode uses the oracle path with a no-drop capacity
+# (every (token, choice) pair keeps a slot), so a token's output never
+# depends on which other sequences share its batch row neighborhood —
+# the row-isolation property the admit/retire tests assert.
+
+
+def init_decode_params(stages, experts, d=16, heads=2, hidden=32,
+                       vocab=64, seed=0):
+    """:func:`init_params` plus a tied token embedding ``emb``
+    [vocab, d] (logits = h @ emb.T)."""
+    params = init_params(stages, experts, d=d, heads=heads,
+                         hidden=hidden, seed=seed)
+    rng = numpy.random.RandomState(seed + 1)
+    params["emb"] = jnp.asarray(
+        rng.standard_normal((vocab, d)) * 0.25, jnp.float32)
+    return params
+
+
+def _stacked(params):
+    """The per-stage leaves (everything but the shared embedding)."""
+    return {n: params[n] for n in ("qkv", "proj", "wr", "w1", "w2")}
+
+
+def _moe_dense(p_i, h, k):
+    """No-drop oracle MoE for ``h`` [N, d]: capacity covers every
+    (token, choice) pair, so routing is per-token independent."""
+    return moe_reference(_expert_ffn,
+                         {"w1": p_i["w1"], "w2": p_i["w2"]},
+                         p_i["wr"], h, capacity=h.shape[0] * k, k=k)
+
+
+def _prefill_block(p_i, h, heads, k):
+    """One dense causal block over the whole prompt; returns the block
+    output and this layer's K/V ([T, H, hd]) for the cache."""
+    b, t, d = h.shape
+    qkv = _rmsnorm(h) @ p_i["qkv"]
+    q, kk, vv = (qkv[..., i * d:(i + 1) * d].reshape(b, t, heads,
+                                                     d // heads)
+                 for i in range(3))
+    a = attention_reference(q, kk, vv, causal=True)
+    h = h + a.reshape(b, t, d) @ p_i["proj"]
+    moe = _moe_dense(p_i, _rmsnorm(h).reshape(b * t, d), k)
+    return h + moe.reshape(b, t, d), kk[0], vv[0]
+
+
+def prefill(params, tokens, length, k_pools, v_pools, block_row, *,
+            heads=2, block_size=8, k=1):
+    """Prompt pass: dense causal forward over ``tokens`` [T_bucket]
+    (padded; ``length`` valid), writing each layer's K/V for positions
+    < length into the pool blocks named by ``block_row`` [max_blocks].
+    Returns (first generated token, k_pools, v_pools).  jit-able; one
+    executable per T bucket."""
+    t = int(tokens.shape[0])
+    h = params["emb"][tokens][None]              # [1, T, d]
+    stacked = _stacked(params)
+    stages = stacked["qkv"].shape[0]
+    pos = jnp.arange(t)
+    valid = pos < length
+    # invalid positions scatter into physical block 0 — the pool's
+    # reserved trash block, never owned by a live sequence
+    blk = jnp.where(valid, block_row[pos // block_size], 0)
+    off = pos % block_size
+    new_k, new_v = [], []
+    for i in range(stages):
+        p_i = jax.tree.map(lambda p: p[i], stacked)
+        h, kk, vv = _prefill_block(p_i, h, heads, k)
+        new_k.append(k_pools[i].at[blk, off].set(kk))
+        new_v.append(v_pools[i].at[blk, off].set(vv))
+    logits = h[0, length - 1] @ params["emb"].T
+    token = jnp.argmax(logits).astype(jnp.int32)
+    return token, tuple(new_k), tuple(new_v)
+
+
+def _decode_block(p_i, h, k_pool_i, v_pool_i, page_table, lengths,
+                  blk, off, heads, k):
+    """One single-token block: write this token's K/V into its pool
+    slot, then ragged paged attention over the whole cached history
+    (lengths + 1 includes the token just written)."""
+    from ..paged_attention import paged_attention
+    b, d = h.shape
+    hd = d // heads
+    qkv = _rmsnorm(h) @ p_i["qkv"]               # [B, 3d]
+    q, kk, vv = (qkv[:, i * d:(i + 1) * d].reshape(b, heads, hd)
+                 for i in range(3))
+    k_pool_i = k_pool_i.at[blk, off].set(kk)
+    v_pool_i = v_pool_i.at[blk, off].set(vv)
+    a = paged_attention(q, k_pool_i, v_pool_i, page_table, lengths + 1,
+                        scale=1.0 / math.sqrt(hd))
+    h = h + a.reshape(b, d) @ p_i["proj"]
+    return h + _moe_dense(p_i, _rmsnorm(h), k), k_pool_i, v_pool_i
+
+
+def decode_step(params, k_pools, v_pools, page_table, lengths, tokens,
+                *, heads=2, block_size=8, k=1):
+    """One token for every row: embed ``tokens`` [B], write each row's
+    K/V at position ``lengths[row]``, attend through the page table,
+    return (next greedy tokens [B], k_pools, v_pools).
+
+    Static shapes throughout — max-batch rows and the [B, max_blocks]
+    page table — so the serving scheduler compiles this ONCE and runs
+    arbitrary admit/retire mixes against the same executable.  Padding
+    rows (lengths == 0 with an all-zero table row) write into the trash
+    block and produce ignored tokens.
+    """
+    b = int(tokens.shape[0])
+    h = params["emb"][tokens]                    # [B, d]
+    stacked = _stacked(params)
+    stages = stacked["qkv"].shape[0]
+    rows = jnp.arange(b)
+    blk = page_table[rows, lengths // block_size]
+    off = lengths % block_size
+    k_pools, v_pools = list(k_pools), list(v_pools)
+    for i in range(stages):
+        p_i = jax.tree.map(lambda p: p[i], stacked)
+        h, k_pools[i], v_pools[i] = _decode_block(
+            p_i, h, k_pools[i], v_pools[i], page_table, lengths, blk,
+            off, heads, k)
+    logits = h @ params["emb"].T                 # [B, V]
+    return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            tuple(k_pools), tuple(v_pools))
+
+
+def generate_reference(params, prompt, n_new, heads=2, k=1):
+    """Cache-free greedy oracle: rerun the full dense causal forward
+    over the whole history for every generated token.  O(T^2) per
+    token — tests only."""
+    tokens = [int(t) for t in prompt]
+    stacked = _stacked(params)
+    stages = stacked["qkv"].shape[0]
+    out = []
+    for _ in range(n_new):
+        h = params["emb"][jnp.asarray(tokens, jnp.int32)][None]
+        for i in range(stages):
+            p_i = jax.tree.map(lambda p: p[i], stacked)
+            h, _, _ = _prefill_block(p_i, h, heads, k)
+        logits = h[0, -1] @ params["emb"].T
+        nxt = int(jnp.argmax(logits))
+        out.append(nxt)
+        tokens.append(nxt)
+    return out
+
+
+class FlagshipDecodeModel:
+    """The decode-serving adapter: flagship params + the jit-able
+    prefill / decode-step closures the token-level scheduler
+    (serving/decode.py) compiles.  ``kind = "decode"`` is what
+    ModelRegistry.add dispatches on."""
+
+    kind = "decode"
+
+    def __init__(self, params=None, *, stages=2, experts=2, d=16,
+                 heads=2, hidden=32, vocab=64, k=1, seed=0):
+        if params is None:
+            params = init_decode_params(stages, experts, d=d,
+                                        heads=heads, hidden=hidden,
+                                        vocab=vocab, seed=seed)
+        self.params = params
+        self.heads = int(heads)
+        self.k = int(k)
+        self.layers = int(params["qkv"].shape[0])
+        self.vocab = int(params["emb"].shape[0])
+        self.d = int(params["emb"].shape[1])
+        if self.d % self.heads:
+            raise ValueError("d=%d not divisible by heads=%d"
+                             % (self.d, self.heads))
+        self.head_dim = self.d // self.heads
+
+    def make_pools(self, num_blocks, block_size):
+        """Fresh zeroed per-layer K and V pools
+        ([num_blocks, block_size, H, hd] x layers)."""
+        shape = (int(num_blocks), int(block_size), self.heads,
+                 self.head_dim)
+        k_pools = tuple(jnp.zeros(shape, jnp.float32)
+                        for _ in range(self.layers))
+        v_pools = tuple(jnp.zeros(shape, jnp.float32)
+                        for _ in range(self.layers))
+        return k_pools, v_pools
+
+    def prefill_fn(self, block_size):
+        """(tokens, length, k_pools, v_pools, block_row) ->
+        (first token, pools) — close over the static geometry."""
+        params, heads, k = self.params, self.heads, self.k
+
+        def fn(tokens, length, k_pools, v_pools, block_row):
+            return prefill(params, tokens, length, k_pools, v_pools,
+                           block_row, heads=heads,
+                           block_size=block_size, k=k)
+        return fn
+
+    def decode_fn(self, block_size):
+        """(k_pools, v_pools, page_table, lengths, tokens) ->
+        (next tokens, pools)."""
+        params, heads, k = self.params, self.heads, self.k
+
+        def fn(k_pools, v_pools, page_table, lengths, tokens):
+            return decode_step(params, k_pools, v_pools, page_table,
+                               lengths, tokens, heads=heads,
+                               block_size=block_size, k=k)
+        return fn
+
+
 def train_step(params, x, target, mesh, lr=0.05, **kwargs):
     """One fused SGD step of the full composition; jit-able."""
     def loss_fn(p):
