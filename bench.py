@@ -69,6 +69,14 @@ Also reported in the same JSON line:
   decode executables (tools/serve_bench.py --decode), run cold then
   warm in fresh subprocesses so ``decode_warm_compiles == 0`` proves
   the zero-recompile restart via the compile-cache manifest.
+- ``fleet_rps`` + ``fleet_scaling_efficiency`` +
+  ``fleet_kill_{failed,recovery_s}`` + ``fleet_respawn_compiles`` +
+  ``fleet_rollout_{failed,s}`` — the multi-replica serving fleet
+  (ISSUE 7, tools/serve_bench.py --fleet): closed-loop req/s of N
+  replicas behind the least-loaded router vs one admitted replica,
+  plus the SIGKILL and rolling-update drills under open-loop load
+  (zero non-429 failures = the zero-downtime evidence; respawn
+  ``compiles == 0`` = the warm-spawn evidence).
 - ``snapshot_stall_speedup`` + ``snapshot_stall_{sync,async}_ms`` +
   ``snapshot_write_gz{9,6}_ms`` — the checkpointing path (ISSUE 4):
   per-snapshot training-thread stall on the MNIST step loop with the
@@ -749,6 +757,50 @@ def bench_decode(probe_timeout=240):
     return out
 
 
+def bench_fleet(replicas=3, probe_timeout=360):
+    """Multi-replica serving fleet (ISSUE 7 acceptance: >= 0.8
+    replica-scaling efficiency on the open-loop serve_bench load, a
+    SIGKILL mid-load with zero failed non-429 responses and a warm
+    (compiles == 0) respawn, and a zero-downtime rolling update).  The
+    whole fleet runs in ONE fresh subprocess driving
+    ``tools/serve_bench.py --fleet N`` — the replicas are its
+    grandchildren, so a wedged replica dies with the stage instead of
+    leaking."""
+    import subprocess
+    import tempfile
+    _stamp("fleet stage")
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "serve_bench.py")
+    cache_dir = os.path.join(
+        tempfile.mkdtemp(prefix="veles-fleet-bench-"), "compile_cache")
+    argv = [sys.executable, tool, "--fleet", str(replicas),
+            "--seconds", "2", "--json", "--cache-dir", cache_dir]
+    proc = subprocess.run(argv, capture_output=True,
+                          timeout=probe_timeout)
+    line = _last_json_line(proc.stdout.decode())
+    if line is None:
+        raise RuntimeError("fleet probe failed: %s"
+                           % proc.stderr.decode()[-400:])
+    _stamp("fleet: %s req/s on %d replicas (efficiency %s), kill "
+           "failed=%s recovery=%ss respawn compiles=%s, rollout "
+           "failed=%s"
+           % (line.get("fleet_rps"), replicas,
+              line.get("fleet_scaling_efficiency"),
+              line.get("fleet_kill_failed"),
+              line.get("fleet_kill_recovery_s"),
+              line.get("fleet_respawn_compiles"),
+              line.get("fleet_rollout_failed")))
+    keys = ("fleet_replicas", "fleet_rps", "fleet_single_rps",
+            "fleet_speedup_vs_single", "fleet_scaling_efficiency",
+            "fleet_start_s", "fleet_kill_ok", "fleet_kill_shed",
+            "fleet_kill_failed", "fleet_kill_recovery_s",
+            "fleet_respawn_compiles", "fleet_respawn_cache_hits",
+            "fleet_retries", "fleet_rollout_s", "fleet_rollout_ok",
+            "fleet_rollout_shed", "fleet_rollout_failed",
+            "fleet_rollout_error_rate")
+    return {k: line.get(k) for k in keys}
+
+
 def bench_observability(batch=512, steps=64, repeats=5):
     """Tracing+metrics overhead on the MNIST per-step loop (ISSUE 2
     acceptance: < 5%): the SAME per-launch step loop timed bare, then
@@ -985,6 +1037,8 @@ def _stage_main(stage):
         out = bench_cold_start()
     elif stage == "decode":
         out = bench_decode()
+    elif stage == "fleet":
+        out = bench_fleet()
     else:
         raise SystemExit("unknown stage %r" % stage)
     out["spread"] = SPREAD
@@ -1038,6 +1092,11 @@ STAGE_PLAN = [
     # steady-state recompiles across a warm restart) — two fresh
     # subprocesses (cold populates the cache, warm IS the restart)
     ("decode", 420),
+    # multi-replica serving fleet: scaling efficiency, SIGKILL
+    # kill-recovery (zero non-429 failures, warm compiles==0 respawn)
+    # and rolling-update error rate (ISSUE 7) — one fresh subprocess
+    # owning router + N replica grandchildren under a hard cap
+    ("fleet", 420),
 ]
 
 

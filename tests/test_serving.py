@@ -410,3 +410,187 @@ def test_http11_keepalive_connection_reuse(mnist_package):
         conn.close()
     finally:
         server.stop()
+
+
+# -- readiness, computed Retry-After, hot swap (ISSUE 7 satellites) -----------
+
+def test_readyz_gates_on_warmup_ladder():
+    """/readyz is 503 until the whole bucket ladder is compiled (and
+    while no model exists, and while draining); /healthz stays pure
+    liveness — 200 "ok" throughout."""
+    from veles_tpu.serving.scheduler import OpaqueModel
+
+    gate = threading.Event()
+
+    class GatedModel(OpaqueModel):
+        """Compiles bucket 1 instantly, blocks the tail on ``gate``."""
+
+        def compile(self, bucket, cache=None):
+            if bucket > 1:
+                gate.wait(10)
+            return self._fn, None
+
+    def ready_status(port):
+        try:
+            resp = urllib.request.urlopen(
+                "http://127.0.0.1:%d/readyz" % port, timeout=5)
+            return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    server = InferenceServer()
+    try:
+        status, body = ready_status(server.port)
+        assert status == 503 and body["ready"] is False   # no models
+        server.registry.add(
+            "g", GatedModel(lambda x: x, sample_shape=(4,)),
+            max_batch=4, background_warmup=True)
+        status, body = ready_status(server.port)
+        assert status == 503 and body["models"] == {"g": False}
+        health = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/healthz" % server.port).read())
+        assert health["status"] == "ok"       # liveness != readiness
+        gate.set()
+        assert server.registry.get("g").scheduler.join_warmup(10)
+        status, body = ready_status(server.port)
+        assert status == 200 and body["ready"] is True
+        assert body["load"]["g"]["queue_depth"] == 0
+        server.draining = True                # drain drops readiness...
+        assert ready_status(server.port)[0] == 503
+        server.draining = False
+        assert ready_status(server.port)[0] == 200
+    finally:
+        gate.set()
+        server.stop()
+
+
+def test_retry_after_computed_from_backlog():
+    """The shed Retry-After comes from queue depth x recent batch
+    latency (capped), not the old hardcoded "1" — unit level and
+    through the HTTP 429 header."""
+    def slowish(x):
+        time.sleep(0.4)
+        return x
+
+    sched = BucketScheduler(lambda x: x, max_batch=1, queue_limit=64,
+                            sample_shape=(4,), name="ra")
+    try:
+        assert sched.retry_after_s() == 1     # no latency data yet
+        for _ in range(6):
+            sched.metrics.batch_latency.record(2.0)
+        sched._depth = 10                     # 10 batches x 2 s each
+        assert sched.retry_after_s() == 20
+        sched._depth = 1000
+        assert sched.retry_after_s() == 30    # capped
+        sched._depth = 0
+    finally:
+        sched.close()
+
+    server = InferenceServer(max_batch=1, queue_limit=3)
+    server.registry.add("slow", slowish, sample_shape=(4,))
+    entry = server.registry.get("slow")
+    try:
+        for _ in range(4):                    # seed the batch window
+            entry.scheduler.metrics.batch_latency.record(1.0)
+        futures = [entry.scheduler.submit(
+            numpy.ones((1, 4), numpy.float32)) for _ in range(3)]
+        code, (headers, body) = None, (None, None)
+        try:
+            _post(server.port, {"input": [[1.0, 2.0, 3.0, 4.0]]},
+                  "/api/slow")
+        except urllib.error.HTTPError as e:
+            code = e.code
+            headers, body = dict(e.headers), json.loads(e.read())
+        assert code == 429
+        # 3 outstanding x ~1 s recent batch latency -> a 3 s hint
+        assert headers.get("Retry-After") == "3"
+        assert body["retry_after_s"] == 3
+        for f in futures:
+            f.result(timeout=10)
+    finally:
+        server.stop()
+
+
+def test_hot_swap_under_concurrent_traffic():
+    """Registry hot-load under load (ISSUE 7 satellite): in-flight
+    requests against the old version complete correctly while add()
+    swaps versions — every response is a coherent v1 or v2 answer,
+    never a 500 or a torn read."""
+    def v1(x):
+        time.sleep(0.002)
+        return x * 0 + 1.0
+
+    def v2(x):
+        time.sleep(0.002)
+        return x * 0 + 2.0
+
+    server = InferenceServer(max_batch=4)
+    server.registry.add("hot", v1, sample_shape=(4,), version="v1")
+    failures, seen = [], set()
+    stop = threading.Event()
+
+    def client(i):
+        while not stop.is_set():
+            try:
+                resp = _post(server.port, {"input": [[0.0] * 4]},
+                             "/api/hot")
+                row = resp["output"][0]
+                if row not in ([1.0] * 4, [2.0] * 4):
+                    failures.append("torn read: %r" % (row,))
+                seen.add(row[0])
+            except Exception as e:
+                failures.append("client %d: %r" % (i, e))
+    try:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        for model, version in ((v2, "v2"), (v1, "v1"), (v2, "v2")):
+            server.registry.add("hot", model, sample_shape=(4,),
+                                version=version)
+            time.sleep(0.1)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10)
+    assert not failures, failures[:5]
+    assert seen == {1.0, 2.0}                 # both versions answered
+    resp = _post(server.port, {"input": [[0.0] * 4]}, "/api/hot")
+    assert resp["output"][0] == [2.0] * 4     # final version serves
+    assert server.registry.get("hot").version == "v2"
+    server.stop()
+
+
+def test_admin_hot_load_endpoint_gated():
+    """POST /admin/models is 404 on a stock server (surface unchanged)
+    and performs a versioned hot-load when enable_admin is on."""
+    from veles_tpu.fleet import resolve_model_spec
+
+    plain = InferenceServer()
+    try:
+        code, body = _post_err(plain.port,
+                               {"name": "m", "model": "sleep:0.001:4"},
+                               "/admin/models")
+        assert code == 404
+    finally:
+        plain.stop()
+
+    server = InferenceServer(enable_admin=True,
+                             model_resolver=resolve_model_spec)
+    try:
+        out = _post(server.port,
+                    {"name": "m", "model": "sleep:0.001:4",
+                     "version": "v1"}, "/admin/models")
+        assert out == {"model": "m", "version": "v1", "ready": True}
+        resp = _post(server.port, {"input": [[1.0, 2.0, 3.0, 4.0]]},
+                     "/api/m")
+        assert resp["output"] == [[1.0, 2.0, 3.0, 4.0]]
+        described = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/models" % server.port).read())
+        assert described["m"]["version"] == "v1"
+        code, body = _post_err(server.port, {"name": "m"},
+                               "/admin/models")
+        assert code == 400                    # malformed admin payload
+    finally:
+        server.stop()

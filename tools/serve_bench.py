@@ -482,6 +482,221 @@ def run_decode_bench(seconds=2.0, n_requests=None, max_batch=8,
     return out
 
 
+# -- fleet load mode ----------------------------------------------------------
+#
+# The multi-replica counterpart (ISSUE 7): the SAME open/closed-loop
+# generators above, pointed at a FleetRouter in front of N replica
+# subprocesses, measuring the three fleet acceptance numbers —
+#
+# - ``fleet_scaling_efficiency``: closed-loop req/s with all N replicas
+#   admitted vs ONE (the other N-1 quiesced at the router, so both
+#   windows share processes, warm caches, and machine state);
+# - kill drill: SIGKILL one replica under an open-loop load — failed
+#   (non-429) responses must stay 0 while the supervisor respawns it
+#   warm (``fleet_respawn_compiles == 0`` off the shared compile
+#   cache);
+# - rollout drill: a rolling model update under the same load — the
+#   error count over the rollout window is the zero-downtime evidence.
+
+
+def _http_status_open_loop(port, offered_rps, seconds, sizes,
+                           sample_shape, route="/api/mnist"):
+    """Paced open loop that records STATUS CLASSES: (ok, shed_429,
+    failed) — the fleet drills need "non-429 failures == 0", which the
+    closed-loop helper's single error bucket cannot express."""
+    bodies = {bs: json.dumps({"input": numpy.random.RandomState(bs)
+                              .uniform(-1, 1, (bs,) + tuple(sample_shape))
+                              .round(4).tolist()}).encode()
+              for bs in sizes}
+    lock = threading.Lock()
+    out = {"ok": 0, "shed": 0, "failed": 0, "latencies": []}
+
+    def fire(body):
+        t0 = time.perf_counter()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            conn.request("POST", route, body,
+                         {"Content-Type": "application/json"})
+            status = conn.getresponse()
+            status.read()
+            code = status.status
+            conn.close()
+        except Exception:
+            code = -1
+        with lock:
+            if code == 200:
+                out["ok"] += 1
+                out["latencies"].append(time.perf_counter() - t0)
+            elif code == 429:
+                out["shed"] += 1
+            else:
+                out["failed"] += 1
+
+    threads = []
+    start = time.perf_counter()
+    n_arrivals = max(1, int(offered_rps * seconds))
+    for k in range(n_arrivals):
+        due = start + k / offered_rps
+        delay = due - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(target=fire,
+                             args=(bodies[sizes[k % len(sizes)]],))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    out["elapsed"] = time.perf_counter() - start
+    return out
+
+
+def run_fleet_bench(replicas=3, clients=None, seconds=2.0,
+                    sizes=DEFAULT_SIZES, package=None, max_batch=16,
+                    offered_rps=60.0, drill_seconds=4.0,
+                    cache_dir=None, row_latency=0.01):
+    """Replica scaling + kill/rollout drills through the router;
+    returns the result dict (``fleet_*`` keys ride into the bench
+    JSON).
+
+    Scaling is measured on the ``sleep:`` stand-in model (a fixed
+    device-time-per-row twin, see fleet/replica.py): on a small shared
+    CPU host the real MNIST forward is microseconds, so one replica's
+    batching amortization beats process parallelism and — on a
+    single-core box — CPU-bound work cannot scale across replicas BY
+    CONSTRUCTION.  The drills (SIGKILL failover, rolling update, warm
+    respawn compiles) run against the real exported package, where the
+    compile-cache and hot-load machinery actually engage."""
+    import shutil
+    import signal
+    from veles_tpu.fleet import Fleet
+
+    tmp = None
+    if package is None:
+        tmp = tempfile.mkdtemp(prefix="fleet_bench_")
+        package = build_mnist_package(os.path.join(tmp, "mnist_pkg.zip"))
+    if cache_dir is None:
+        cache_dir = os.path.join(tmp or tempfile.mkdtemp(
+            prefix="fleet_bench_"), "compile_cache")
+    from veles_tpu.export.loader import PackageLoader
+    sample_shape = tuple(PackageLoader(package)
+                         .model_metadata["input"]["sample_shape"])
+    lat_model = "sleep:%s:4" % row_latency
+    clients = clients or 4 * replicas
+
+    out = {"fleet_replicas": replicas, "fleet_clients": clients,
+           "fleet_max_batch": max_batch,
+           "fleet_scaling_model": lat_model}
+    t0 = time.perf_counter()
+    fleet = Fleet({"mnist": package, "lat": lat_model},
+                  replicas=replicas, max_batch=max_batch,
+                  cache_dir=cache_dir, poll_interval=0.1,
+                  backoff={"base": 0.2, "factor": 2.0, "cap": 5.0,
+                           "max_restarts": 10})
+    fleet.start(ready_timeout=300)
+    out["fleet_start_s"] = round(time.perf_counter() - t0, 2)
+    rids = fleet.router.replica_ids()
+    try:
+        # -- scaling: one admitted replica vs all, interleaved ---------------
+        lat_sizes, lat_shape = (1,), (4,)   # one row per request
+
+        def window(n_admit):
+            for rid in rids:
+                fleet.router.set_admitting(rid, rid in rids[:n_admit])
+            _http_closed_loop(fleet.port, 2, 0.2, lat_sizes, lat_shape,
+                              route="/api/lat")            # warm
+            return _http_closed_loop(fleet.port, clients,
+                                     seconds, lat_sizes, lat_shape,
+                                     route="/api/lat")
+        single = {"n": 0, "t": 0.0}
+        full = {"n": 0, "t": 0.0}
+        for _ in range(2):                  # interleaved: drift cancels
+            rps, lat, err = window(1)
+            single["n"] += rps * seconds
+            single["t"] += seconds
+            rps, lat, err = window(len(rids))
+            full["n"] += rps * seconds
+            full["t"] += seconds
+        for rid in rids:
+            fleet.router.set_admitting(rid, True)
+        single_rps = single["n"] / single["t"]
+        fleet_rps = full["n"] / full["t"]
+        out["fleet_single_rps"] = round(single_rps, 1)
+        out["fleet_rps"] = round(fleet_rps, 1)
+        out["fleet_speedup_vs_single"] = round(fleet_rps / single_rps,
+                                               2) if single_rps else None
+        out["fleet_scaling_efficiency"] = round(
+            fleet_rps / (replicas * single_rps), 3) if single_rps \
+            else None
+
+        # -- kill drill: SIGKILL one replica under open-loop load ------------
+        victim = rids[-1]
+        drill = {}
+
+        def run_drill():
+            drill.update(_http_status_open_loop(
+                fleet.port, offered_rps, drill_seconds, sizes,
+                sample_shape))
+        loader = threading.Thread(target=run_drill)
+        loader.start()
+        time.sleep(drill_seconds * 0.25)
+        t_kill = time.perf_counter()
+        fleet.supervisor.kill(victim, signal.SIGKILL)
+        # recovery = kill → the router has SEEN the death and then
+        # reports the respawned replica ready again (reading ready
+        # before the down transition would clock a stale 0s)
+        seen_down = False
+        recovered = None
+        while time.perf_counter() - t_kill < 120:
+            rep = fleet.router.replica(victim)
+            up = rep is not None and rep.up and rep.ready
+            if not seen_down:
+                seen_down = not up
+            elif up:
+                recovered = time.perf_counter() - t_kill
+                break
+            time.sleep(0.02)
+        loader.join()
+        out["fleet_kill_ok"] = drill["ok"]
+        out["fleet_kill_shed"] = drill["shed"]
+        out["fleet_kill_failed"] = drill["failed"]
+        out["fleet_kill_recovery_s"] = round(recovered, 2) \
+            if recovered else None
+        # the respawned replica's compile counters: the warm-spawn proof
+        met = fleet.router.merged_metrics()
+        respawned = (met["replicas"].get(victim) or {}).get("mnist") or {}
+        out["fleet_respawn_compiles"] = respawned.get("compiles")
+        out["fleet_respawn_cache_hits"] = respawned.get("cache_hits")
+        out["fleet_retries"] = sum(
+            r["retries"] for r in met["router"]["replicas"].values())
+
+        # -- rollout drill: rolling update under the same load ---------------
+        drill2 = {}
+
+        def run_drill2():
+            drill2.update(_http_status_open_loop(
+                fleet.port, offered_rps, drill_seconds, sizes,
+                sample_shape))
+        loader = threading.Thread(target=run_drill2)
+        loader.start()
+        time.sleep(drill_seconds * 0.1)
+        rollout = fleet.rolling_update("mnist", package, version="v2")
+        loader.join()
+        out["fleet_rollout_s"] = rollout["seconds"]
+        out["fleet_rollout_updated"] = len(rollout["updated"])
+        out["fleet_rollout_ok"] = drill2["ok"]
+        out["fleet_rollout_shed"] = drill2["shed"]
+        out["fleet_rollout_failed"] = drill2["failed"]
+        out["fleet_rollout_error_rate"] = round(
+            drill2["failed"] / max(drill2["ok"] + drill2["shed"]
+                                   + drill2["failed"], 1), 4)
+    finally:
+        fleet.stop()
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="serve_bench",
@@ -523,8 +738,40 @@ def main(argv=None):
     p.add_argument("--cache-dir", default=None,
                    help="persistent executable cache dir (decode mode; "
                         "run twice to prove the zero-recompile warm "
-                        "restart)")
+                        "restart; fleet mode: shared by every replica)")
+    p.add_argument("--fleet", type=int, default=None, metavar="N",
+                   help="fleet load mode: N replica subprocesses behind "
+                        "the FleetRouter — replica-scaling efficiency "
+                        "plus SIGKILL and rolling-update drills under "
+                        "open-loop load")
+    p.add_argument("--drill-seconds", type=float, default=4.0,
+                   help="open-loop window for each fleet drill")
     args = p.parse_args(argv)
+
+    if args.fleet:
+        out = run_fleet_bench(
+            replicas=args.fleet, clients=args.clients,
+            seconds=args.seconds, package=args.package,
+            max_batch=min(args.max_batch, 16),
+            offered_rps=args.offered_rps or 60.0,
+            drill_seconds=args.drill_seconds, cache_dir=args.cache_dir)
+        line = {"metric": "fleet_rps", "value": out.get("fleet_rps"),
+                "unit": "req/s"}
+        line.update(out)
+        if not args.json:
+            print("fleet bench: %s req/s on %d replicas vs %s single "
+                  "(efficiency %s); kill drill failed=%s recovery=%ss "
+                  "respawn compiles=%s; rollout failed=%s in %ss"
+                  % (out.get("fleet_rps"), args.fleet,
+                     out.get("fleet_single_rps"),
+                     out.get("fleet_scaling_efficiency"),
+                     out.get("fleet_kill_failed"),
+                     out.get("fleet_kill_recovery_s"),
+                     out.get("fleet_respawn_compiles"),
+                     out.get("fleet_rollout_failed"),
+                     out.get("fleet_rollout_s")), file=sys.stderr)
+        print(json.dumps(line))
+        return 0
 
     if args.decode:
         out = run_decode_bench(

@@ -25,6 +25,7 @@ across).
 
 import glob
 import os
+import random
 import subprocess
 import sys
 import time
@@ -32,6 +33,70 @@ import time
 from .compilecache import inject_env as _cache_inject_env
 from .observability import trace as _trace
 from .units import Unit
+
+
+class RestartBudgetExhausted(RuntimeError):
+    """A supervised process crashed more times than its budget allows."""
+
+
+class RestartBackoff:
+    """Exponential backoff with jitter and a max-restart budget.
+
+    The respawn policy shared by :class:`ElasticRunner` (training
+    checkpoint-restart) and :class:`veles_tpu.fleet.supervisor
+    .ReplicaSupervisor` (serving replicas): a crash-looping child must
+    not hot-spin the host, and many children restarting after a common
+    cause must not stampede in lockstep — so the delay grows
+    ``base * factor^streak`` (capped at ``cap``) with a ±``jitter``
+    fraction of multiplicative noise.
+
+    ``restarts`` counts every restart ever granted (the budget);
+    ``streak`` counts consecutive crashes and is what the exponent
+    uses — :meth:`note_uptime` resets the streak after a healthy run of
+    ``reset_after`` seconds WITHOUT refunding the budget, so a process
+    that crashes once a day restarts fast forever while one that
+    crashes every second walks up to ``cap`` and eventually exhausts.
+
+    Deterministic for tests: inject ``rng`` (a ``random.random``-like
+    callable) and read delays from :meth:`next_delay` — no wall clock
+    inside.
+    """
+
+    def __init__(self, base=1.0, factor=2.0, cap=60.0, jitter=0.1,
+                 max_restarts=5, reset_after=None, rng=None):
+        self.base = float(base)
+        self.factor = float(factor)
+        self.cap = float(cap)
+        self.jitter = float(jitter)
+        self.max_restarts = (None if max_restarts is None
+                             else int(max_restarts))
+        self.reset_after = reset_after
+        self._rng = rng or random.random
+        self.restarts = 0
+        self.streak = 0
+
+    @property
+    def exhausted(self):
+        return (self.max_restarts is not None
+                and self.restarts >= self.max_restarts)
+
+    def next_delay(self):
+        """Grant one restart: seconds to wait before it, or ``None``
+        when the budget is exhausted (the caller gives up)."""
+        if self.exhausted:
+            return None
+        delay = min(self.base * self.factor ** self.streak, self.cap)
+        if self.jitter:
+            delay *= 1.0 + self.jitter * (2.0 * self._rng() - 1.0)
+        self.restarts += 1
+        self.streak += 1
+        return delay
+
+    def note_uptime(self, seconds):
+        """The child just ran healthily for ``seconds`` before dying;
+        a long-enough run resets the exponent (not the budget)."""
+        if self.reset_after is not None and seconds >= self.reset_after:
+            self.streak = 0
 
 
 class Reaper(Unit):
@@ -88,7 +153,8 @@ class ElasticRunner:
 
     def __init__(self, model, argv=(), snapshot_dir=".", prefix="wf",
                  max_respawns=5, backoff=1.0, backoff_factor=2.0,
-                 python=None, env=None, silent=False):
+                 backoff_cap=60.0, jitter=0.1, reset_after=None,
+                 python=None, env=None, silent=False, rng=None):
         self.model = model
         self.argv = list(argv)
         self.snapshot_dir = snapshot_dir
@@ -96,6 +162,10 @@ class ElasticRunner:
         self.max_respawns = max_respawns
         self.backoff = backoff
         self.backoff_factor = backoff_factor
+        self._policy = RestartBackoff(
+            base=backoff, factor=backoff_factor, cap=backoff_cap,
+            jitter=jitter, max_restarts=max_respawns,
+            reset_after=reset_after, rng=rng)
         self.python = python or sys.executable
         self.env = env
         self.silent = silent
@@ -104,7 +174,6 @@ class ElasticRunner:
 
     def run(self):
         """Returns the final returncode (0 = the run completed)."""
-        delay = self.backoff
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         # every (re)launch joins the supervisor's trace: crash-restart
         # chains then read as one causal timeline in the merged trace
@@ -118,21 +187,23 @@ class ElasticRunner:
             snapshot = latest_snapshot(self.snapshot_dir, self.prefix)
             if snapshot:
                 argv += ["--snapshot", snapshot]
+            t0 = time.monotonic()
             proc = subprocess.run(argv, cwd=repo, env=env,
                                   capture_output=self.silent)
             self.history.append({"rc": proc.returncode,
                                  "resumed_from": snapshot})
             if proc.returncode == 0:
                 return 0
-            if self.respawns >= self.max_respawns:
+            self._policy.note_uptime(time.monotonic() - t0)
+            delay = self._policy.next_delay()
+            if delay is None:
                 return proc.returncode
-            self.respawns += 1
+            self.respawns = self._policy.restarts
             if not self.silent:
                 print("elastic: run died rc=%d; respawn %d/%d in %.1fs"
                       % (proc.returncode, self.respawns,
                          self.max_respawns, delay), file=sys.stderr)
             time.sleep(delay)
-            delay *= self.backoff_factor
 
 
 def init_multihost(coordinator_address=None, num_processes=None,

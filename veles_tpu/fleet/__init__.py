@@ -1,0 +1,40 @@
+"""veles_tpu.fleet — multi-host serving: router + replica lifecycle.
+
+The composition layer over :mod:`veles_tpu.serving` (ROADMAP open item
+2, the "millions of users" story): N single-process serving replicas —
+subprocesses on one host, or processes across hosts — behind one front
+router, with zero-downtime rolling model updates.
+
+- :mod:`.router` — :class:`FleetRouter`: least-loaded dispatch on
+  per-replica health/backpressure signals (queue depth, in-flight,
+  KV occupancy), exactly-once retry of idempotent requests on a dead
+  replica, merged ``/metrics`` ``/healthz`` ``/readyz`` ``/models``;
+- :mod:`.supervisor` — :class:`ReplicaSupervisor`: warm replica spawn
+  (compile-cache + warmup-manifest env inherited → zero XLA compiles
+  before ready), crash respawn on the shared
+  :class:`~veles_tpu.distributed.RestartBackoff` policy, and
+  :meth:`~ReplicaSupervisor.rolling_update`; :class:`Fleet` composes
+  both;
+- :mod:`.replica` — the replica process entry
+  (``python -m veles_tpu.fleet.replica``): a stock
+  :class:`~veles_tpu.serving.InferenceServer` with the admin hot-load
+  endpoint on.
+
+Quickstart::
+
+    from veles_tpu.fleet import Fleet
+    fleet = Fleet({"mnist": "mnist_pkg.zip"}, replicas=3).start()
+    # POST fleet.url + "/api/mnist" {"input": [[...]]}
+    fleet.rolling_update("mnist", "mnist_pkg_v2.zip", version="v2")
+    fleet.stop()
+
+or from the CLI: ``python -m veles_tpu.fleet --model mnist=pkg.zip
+--replicas 3``.
+"""
+
+from .replica import resolve_model_spec
+from .router import FleetRouter
+from .supervisor import Fleet, ReplicaSupervisor
+
+__all__ = ["Fleet", "FleetRouter", "ReplicaSupervisor",
+           "resolve_model_spec"]

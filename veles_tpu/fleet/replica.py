@@ -1,0 +1,119 @@
+"""One serving replica: a single-process InferenceServer under fleet
+supervision.
+
+The replica is deliberately NOT a new server — it is exactly the
+PR-1/PR-6 :class:`~veles_tpu.serving.server.InferenceServer` (bucket +
+decode schedulers, registry, warmup manifests) started by
+``python -m veles_tpu.fleet.replica`` with:
+
+- the admin hot-load endpoint enabled (``POST /admin/models``), which is
+  how the supervisor performs rolling model updates;
+- an announce line on stdout — one JSON object
+  ``{"fleet_replica": {"port": ..., "pid": ..., "replica": ...}}`` —
+  printed as soon as the listener is bound, so the supervisor learns
+  the (port-0-allocated) address immediately while readiness stays
+  gated on ``GET /readyz``;
+- trace context and compile-cache dirs adopted from the environment
+  (the supervisor injects both), so a warm spawn deserializes its
+  executable ladder instead of compiling and its spans join the
+  fleet-wide trace.
+
+Model specs (``--model NAME=SPEC``, repeatable):
+
+- a path to an exported package zip (the production case);
+- ``sleep:SECONDS[:DIM]`` — a deterministic device-bound STAND-IN
+  model: it sleeps ``SECONDS`` per sample ROW, then returns the input
+  batch.  Sleeping per row (not per call) means batching cannot
+  amortize it — a replica's throughput is pinned at ``1/SECONDS``
+  rows/s, exactly like a model whose cost is accelerator time.  Fleet
+  tests and benches measure SCHEDULING (scaling, failover, rollout)
+  against it without paying XLA compiles, and replica scaling stays
+  measurable on a single-core CI host, where CPU-bound work cannot
+  scale by construction (on real TPUs each replica owns its own
+  chip, which this emulates).
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+
+def resolve_model_spec(spec):
+    """An admin/CLI model spec → something ``registry.add`` accepts."""
+    if isinstance(spec, str) and spec.startswith("sleep:"):
+        from ..serving.scheduler import OpaqueModel
+        parts = spec.split(":")
+        delay = float(parts[1])
+        dim = int(parts[2]) if len(parts) > 2 else 4
+
+        def fn(x, _delay=delay):
+            time.sleep(_delay * x.shape[0])   # device-time-per-row twin
+            return x
+
+        return OpaqueModel(fn, sample_shape=(dim,))
+    return spec
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="veles_tpu.fleet.replica",
+        description="One fleet serving replica (an InferenceServer "
+                    "with the admin hot-load endpoint on).")
+    p.add_argument("--model", action="append", default=[],
+                   metavar="NAME=SPEC", dest="models",
+                   help="package zip path or sleep:SECONDS[:DIM] "
+                        "(repeatable)")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = pick a free port (announced on stdout)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--queue-limit", type=int, default=256)
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--replica-id", default=None,
+                   help="stable id assigned by the supervisor")
+    p.add_argument("--cache-dir", default=None,
+                   help="persistent executable cache dir (usually "
+                        "inherited via VELES_COMPILE_CACHE_DIR)")
+    args = p.parse_args(argv)
+
+    from ..config import root
+    if args.cache_dir:
+        root.common.compile_cache.dir = args.cache_dir
+    from ..observability import trace as _trace
+    _trace.adopt_env()
+
+    from ..serving import InferenceServer
+    server = InferenceServer(
+        port=args.port, host=args.host, enable_admin=True,
+        model_resolver=resolve_model_spec, max_batch=args.max_batch,
+        queue_limit=args.queue_limit, workers=args.workers)
+    # announce BEFORE warmup: the supervisor learns the address now and
+    # gates traffic on /readyz, which stays 503 until every model below
+    # finishes its ladder
+    print(json.dumps({"fleet_replica": {
+        "port": server.port, "pid": os.getpid(),
+        "replica": args.replica_id}}), flush=True)
+
+    for spec in args.models:
+        name, _, model = spec.partition("=")
+        if not model:
+            model, name = name, os.path.splitext(
+                os.path.basename(name))[0]
+        server.registry.add(name, resolve_model_spec(model))
+
+    done = threading.Event()
+    # SIGTERM = graceful drain (the supervisor's stop path); SIGKILL is
+    # the crash being drilled and never reaches python
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    signal.signal(signal.SIGINT, lambda *_: done.set())
+    done.wait()
+    server.stop(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,442 @@
+"""The fleet front router: one logical service over N replicas.
+
+A byte-level HTTP proxy (it never parses request payloads — routing
+must stay cheap next to model latency) in front of the replica set,
+following the one-service-many-single-host-processes discipline of the
+multi-host TPU serving literature (arXiv 2112.09017, ROADMAP item 2):
+
+- **least-loaded dispatch**: a background thread polls every replica's
+  ``GET /readyz`` (readiness + the schedulers' load snapshot) at
+  ``poll_interval``; each request is forwarded to the admitting, ready
+  replica with the lowest score = router-side in-flight count + queue
+  utilization + KV occupancy.  The router's own in-flight counter moves
+  per request, so burst skew is corrected between polls;
+- **exactly-once retry**: inference requests are idempotent (pure
+  functions of the payload), so a request that fails at the
+  *connection* level — the replica died mid-flight — is retried ONCE
+  against a different replica and the first replica is marked down
+  immediately (the poll thread revives it after respawn).  Replica
+  HTTP statuses (429 backpressure included) pass through untouched:
+  shed is a replica decision, not a router retry;
+- **merged control plane**: ``/healthz`` (router liveness + per-replica
+  up/ready/admitting), ``/readyz`` (200 iff ≥1 replica is ready),
+  ``/models`` (union of the replicas' registries), ``/metrics``
+  (router dispatch/retry counters + every replica's own snapshot) —
+  plus ``veles_fleet_*`` series in the process-global registry;
+- **trace propagation**: every request runs in a ``fleet.route`` span
+  (trace id from the client's ``X-Trace-Id`` or fresh) and the id is
+  forwarded, so the merged Chrome trace reads router → replica request
+  → ``serving.batch`` under one trace id.
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+from http.server import ThreadingHTTPServer
+
+from ..httpjson import JsonRequestHandler
+from ..logger import events
+from ..observability import trace as _trace
+from ..observability.registry import REGISTRY
+
+#: connection-level failures that mark a replica down and allow the
+#: one retry; anything the replica ANSWERED is passed through instead
+_DISPATCH_ERRORS = (OSError, http.client.HTTPException)
+
+
+def get_json(host, port, path, timeout=2.0, method="GET", body=None):
+    """One short-lived JSON request to a replica (poll/merge paths —
+    the proxy hot path keeps persistent connections instead)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        headers = {}
+        if body is not None:
+            body = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        conn.request(method, path, body, headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, json.loads(data) if data else None
+    finally:
+        conn.close()
+
+
+class _Replica:
+    """Router-side view of one replica."""
+
+    __slots__ = ("id", "host", "port", "up", "ready", "admitting",
+                 "inflight", "load", "generation")
+
+    def __init__(self, rid, host, port):
+        self.id = rid
+        self.host = host
+        self.port = port
+        self.up = False
+        self.ready = False
+        self.admitting = True       # rollout drain flips this off
+        self.inflight = 0
+        self.load = {}
+        self.generation = 0         # bumps on re-register (respawn)
+
+    def score(self):
+        """Lower = less loaded.  In-flight dominates (it is exact and
+        instant); the polled queue/KV signals break ties and catch
+        pressure the router did not itself create."""
+        s = float(self.inflight)
+        for model_load in (self.load or {}).values():
+            s += float(model_load.get("utilization") or 0.0)
+            s += float(model_load.get("kv_occupancy") or 0.0)
+        return s
+
+    def describe(self):
+        return {"host": self.host, "port": self.port, "up": self.up,
+                "ready": self.ready, "admitting": self.admitting,
+                "inflight": self.inflight, "load": self.load}
+
+
+class _RouterHandler(JsonRequestHandler):
+    server_ref = None
+    protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True
+    timeout = 60
+
+    def do_POST(self):
+        path = self.path.split("?", 1)[0]
+        if path != "/api" and not path.startswith("/api/"):
+            self.send_json(404, {"error": "not found"})
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        router = self.server_ref
+        with _trace.span_context(
+                trace_id=self.headers.get("X-Trace-Id") or None) as ctx:
+            t0 = time.perf_counter()
+            status, rid, retried = router.dispatch(self, path, body, ctx)
+            events.span("fleet.route", time.perf_counter() - t0,
+                        replica=rid, status=status, retried=retried,
+                        path=path)
+
+    def do_GET(self):
+        router = self.server_ref
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path == "/healthz":
+            self.send_json(200, router.health())
+        elif path == "/readyz":
+            ready = router.ready_count() > 0
+            self.send_json(200 if ready else 503,
+                           {"ready": ready,
+                            "ready_replicas": router.ready_count(),
+                            "replicas": len(router.replica_ids())})
+        elif path == "/models":
+            self.send_json(200, router.merged_models())
+        elif path == "/metrics":
+            self.send_json(200, router.merged_metrics())
+        else:
+            self.send_json(404, {"error": "not found"})
+
+
+class FleetRouter:
+    """Least-loaded HTTP front end over registered replicas.
+
+    Replicas are registered by the supervisor (:meth:`add_replica`) —
+    the router never spawns processes; it only watches, scores, and
+    forwards.  Usable standalone against hand-started replicas too.
+    """
+
+    def __init__(self, port=0, host="127.0.0.1", poll_interval=0.2,
+                 request_timeout=60.0, registry=None):
+        self.request_timeout = float(request_timeout)
+        self.poll_interval = float(poll_interval)
+        self._replicas = {}
+        self._lock = threading.Lock()
+        self._rr = 0                    # tie-break rotation
+        self._tl = threading.local()    # per-thread persistent conns
+        registry = registry or REGISTRY
+        self._g_up = registry.gauge(
+            "veles_fleet_replica_up",
+            "1 while the replica answers its readiness poll",
+            ("replica",))
+        self._g_ready = registry.gauge(
+            "veles_fleet_replica_ready",
+            "1 while the replica reports ready (warmup ladder done, "
+            "not draining)", ("replica",))
+        self._c_dispatch = registry.counter(
+            "veles_fleet_dispatch_total",
+            "Requests forwarded to the replica", ("replica",))
+        self._c_retry = registry.counter(
+            "veles_fleet_retries_total",
+            "Requests retried on another replica after a dead one",
+            ("replica",))
+        self._c_no_replica = registry.counter(
+            "veles_fleet_no_replica_total",
+            "Requests shed because no ready replica was available")
+        handler = type("Handler", (_RouterHandler,),
+                       {"server_ref": self})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.block_on_close = False
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="veles-fleet-router")
+        self._thread.start()
+        self._poller = threading.Thread(
+            target=self._poll_loop, daemon=True,
+            name="veles-fleet-router-poll")
+        self._poller.start()
+
+    @property
+    def url(self):
+        return "http://%s:%d" % (self.host, self.port)
+
+    # -- replica set ---------------------------------------------------------
+    def add_replica(self, rid, host, port):
+        """(Re-)register a replica; a respawn re-registers the same id
+        with its new port and starts not-ready until the poll sees it."""
+        with self._lock:
+            prior = self._replicas.get(rid)
+            rep = _Replica(rid, host, int(port))
+            if prior is not None:
+                rep.admitting = prior.admitting
+                rep.generation = prior.generation + 1
+            self._replicas[rid] = rep
+        self._g_up.labels(replica=rid).set(0)
+        self._g_ready.labels(replica=rid).set(0)
+        self._probe(rep)            # first state without poll latency
+        return rep
+
+    def remove_replica(self, rid):
+        with self._lock:
+            rep = self._replicas.pop(rid, None)
+        if rep is not None:
+            self._g_up.labels(replica=rid).set(0)
+            self._g_ready.labels(replica=rid).set(0)
+        return rep is not None
+
+    def replica_ids(self):
+        with self._lock:
+            return list(self._replicas)
+
+    def replica(self, rid):
+        with self._lock:
+            return self._replicas.get(rid)
+
+    def ready_count(self):
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if r.up and r.ready)
+
+    def set_admitting(self, rid, admitting):
+        """Rollout drain control: an un-admitting replica gets no NEW
+        dispatches but keeps its in-flight ones (watch ``inflight``)."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None:
+                rep.admitting = bool(admitting)
+
+    def mark_down(self, rid):
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None:
+                rep.up = rep.ready = False
+        self._g_up.labels(replica=rid).set(0)
+        self._g_ready.labels(replica=rid).set(0)
+
+    # -- health polling ------------------------------------------------------
+    def _probe(self, rep):
+        try:
+            status, body = get_json(rep.host, rep.port, "/readyz",
+                                    timeout=max(self.poll_interval * 4,
+                                                1.0))
+        except _DISPATCH_ERRORS + (ValueError,):
+            rep.up = rep.ready = False
+        else:
+            rep.up = True
+            rep.ready = status == 200 and bool(
+                isinstance(body, dict) and body.get("ready"))
+            if isinstance(body, dict):
+                rep.load = body.get("load") or {}
+        self._g_up.labels(replica=rep.id).set(int(rep.up))
+        self._g_ready.labels(replica=rep.id).set(int(rep.ready))
+
+    def _poll_loop(self):
+        while not self._closed:
+            self.refresh()
+            time.sleep(self.poll_interval)
+
+    def refresh(self):
+        """Probe every replica NOW (the poll loop's body; also called
+        synchronously when dispatch finds no candidate, so a request
+        arriving right after a replica turned ready — or right after
+        the last candidate died — sees fresh state instead of a stale
+        503)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            if self._closed:
+                return
+            self._probe(rep)
+
+    # -- dispatch ------------------------------------------------------------
+    def pick(self, exclude=()):
+        with self._lock:
+            candidates = [r for r in self._replicas.values()
+                          if r.up and r.ready and r.admitting
+                          and r.id not in exclude]
+            if not candidates:
+                return None
+            best = min(r.score() for r in candidates)
+            ties = [r for r in candidates if r.score() == best]
+            # round-robin among equally-loaded replicas: a light load
+            # must not pin itself to whichever replica sorts first
+            rep = ties[self._rr % len(ties)]
+            self._rr += 1
+            rep.inflight += 1   # reserve under the lock (burst-safe)
+            return rep
+
+    def _conn_for(self, rep):
+        conns = getattr(self._tl, "conns", None)
+        if conns is None:
+            conns = self._tl.conns = {}
+        key = (rep.id, rep.generation)
+        conn = conns.get(key)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.request_timeout)
+            conn.connect()
+            conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+            conns[key] = conn
+        return key, conn
+
+    def _forward(self, rep, path, body, headers):
+        key, conn = self._conn_for(rep)
+        try:
+            conn.request("POST", path, body, headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        except _DISPATCH_ERRORS:
+            conn.close()
+            self._tl.conns.pop(key, None)
+            raise
+        return resp.status, resp.getheaders(), data
+
+    def dispatch(self, handler, path, body, ctx):
+        """Forward one request; writes the response through ``handler``.
+        Returns ``(status, replica_id, retried)`` for the route span."""
+        headers = {"Content-Type": handler.headers.get("Content-Type")
+                   or "application/json",
+                   **_trace.http_headers(ctx)}
+        tried = []
+        for attempt in (0, 1):
+            rep = self.pick(exclude=tried)
+            if rep is None:
+                self.refresh()      # stale view ≠ empty fleet
+                rep = self.pick(exclude=tried)
+            if rep is None:
+                self._c_no_replica.inc()
+                handler.send_json(
+                    503, {"error": "no ready replica"},
+                    headers={"Retry-After": "1",
+                             **_trace.http_headers(ctx)})
+                return 503, None, bool(tried)
+            tried.append(rep.id)
+            try:
+                status, resp_headers, data = self._forward(
+                    rep, path, body, headers)
+            except _DISPATCH_ERRORS:
+                # the replica died under us: it gets no new traffic
+                # until the poll (or supervisor re-register) revives
+                # it, and THIS request retries exactly once elsewhere
+                self.mark_down(rep.id)
+                self._c_retry.labels(replica=rep.id).inc()
+                continue
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+            self._c_dispatch.labels(replica=rep.id).inc()
+            self._respond(handler, status, resp_headers, data)
+            return status, rep.id, attempt > 0
+        handler.send_json(502, {"error": "dispatch failed on %d "
+                                "replicas" % len(tried),
+                                "replicas": tried},
+                          headers=_trace.http_headers(ctx))
+        return 502, tried[-1] if tried else None, True
+
+    @staticmethod
+    def _respond(handler, status, resp_headers, data):
+        """Pass a replica answer through byte-for-byte (429 Retry-After
+        and trace headers included)."""
+        handler.send_response(status)
+        passed = {"content-type", "retry-after", "x-trace-id"}
+        for name, value in resp_headers or ():
+            if name.lower() in passed:
+                handler.send_header(name, value)
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
+    # -- merged control plane ------------------------------------------------
+    def health(self):
+        with self._lock:
+            reps = {rid: rep.describe()
+                    for rid, rep in self._replicas.items()}
+        return {"status": "ok", "replicas": reps,
+                "ready_replicas": sum(1 for r in reps.values()
+                                      if r["up"] and r["ready"])}
+
+    def merged_models(self):
+        """Union of the replicas' ``/models`` — per-model, per-replica
+        (versions differ mid-rollout, and that must be visible)."""
+        out = {"models": {}, "replicas": {}}
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            if not rep.up:
+                continue
+            try:
+                _, body = get_json(rep.host, rep.port, "/models",
+                                   timeout=2.0)
+            except _DISPATCH_ERRORS + (ValueError,):
+                continue
+            if not isinstance(body, dict):
+                continue
+            out["replicas"][rep.id] = body
+            for name, desc in body.items():
+                out["models"].setdefault(name, {})[rep.id] = {
+                    "version": desc.get("version"),
+                    "ready": desc.get("ready")}
+        return out
+
+    def merged_metrics(self):
+        """Router counters + every live replica's own /metrics."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        router = {"replicas": {}, "no_replica_sheds":
+                  int(self._c_no_replica.value)}
+        merged = {"router": router, "replicas": {}}
+        for rep in reps:
+            router["replicas"][rep.id] = {
+                "up": rep.up, "ready": rep.ready,
+                "admitting": rep.admitting, "inflight": rep.inflight,
+                "dispatched": int(
+                    self._c_dispatch.labels(replica=rep.id).value),
+                "retries": int(
+                    self._c_retry.labels(replica=rep.id).value),
+            }
+            if rep.up:
+                try:
+                    _, body = get_json(rep.host, rep.port, "/metrics",
+                                       timeout=2.0)
+                    merged["replicas"][rep.id] = body
+                except _DISPATCH_ERRORS + (ValueError,):
+                    merged["replicas"][rep.id] = {"error": "unreachable"}
+        return merged
+
+    def stop(self):
+        self._closed = True
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -30,7 +30,8 @@ import threading
 import uuid
 
 __all__ = ["new_id", "current", "span_context", "adopt", "payload",
-           "inject_env", "adopt_env", "set_ambient", "TRACE_ENV"]
+           "http_headers", "inject_env", "adopt_env", "set_ambient",
+           "TRACE_ENV"]
 
 #: env var carrying "trace_id:parent_span" across process boundaries
 TRACE_ENV = "VELES_TRACE_CONTEXT"
@@ -121,6 +122,17 @@ def adopt(wire):
     with span_context(trace_id=str(wire["trace_id"]),
                       parent=wire.get("parent_span")) as ctx:
         yield ctx
+
+
+def http_headers(ctx=None):
+    """HTTP form of ``ctx`` (default: current) — the headers an
+    in-process hop (fleet router → replica) forwards so the receiving
+    server's request span joins the same trace.  Empty when no context
+    is active."""
+    ctx = ctx or current()
+    if ctx is None:
+        return {}
+    return {"X-Trace-Id": ctx.trace_id}
 
 
 def inject_env(env=None):

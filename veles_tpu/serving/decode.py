@@ -164,6 +164,7 @@ class DecodeScheduler:
             self._manifest = WarmupManifest(manifest)
         else:
             self._manifest = manifest or None
+        self._warmed = False
         if warmup:
             self.warmup()
         self._worker = threading.Thread(
@@ -257,6 +258,7 @@ class DecodeScheduler:
         for b in self._warmup_order():
             self._get_prefill_exe(b)
         self._warmup_compiles = self._compiles
+        self._warmed = True
 
     # -- request side --------------------------------------------------------
     def validate(self, prompt, max_new_tokens):
@@ -489,6 +491,36 @@ class DecodeScheduler:
     def active_sequences(self):
         return len(self._sessions)
 
+    @property
+    def ready(self):
+        """True once the decode step and the whole prefill ladder are
+        compiled — the ``GET /readyz`` / fleet-admission signal."""
+        return self._warmed and not self._closed
+
+    def load(self):
+        """Cheap backpressure snapshot for routers (int/float reads
+        only — poll-safe)."""
+        depth = self._depth
+        return {"kind": "decode",
+                "queue_depth": depth,
+                "queue_limit": self.queue_limit,
+                "utilization": round(depth / self.queue_limit, 4),
+                "active_rows": len(self._sessions),
+                "kv_occupancy": round(
+                    self._pool.live_blocks /
+                    max(self._pool.capacity, 1), 4)}
+
+    def retry_after_s(self, cap=30):
+        """Computed ``Retry-After`` for shed generate requests: gangs
+        of queued sequences ahead x the tokens each must stream x the
+        recent per-step wall time."""
+        step_p50 = self.metrics.step_latency.summary().get("p50_ms")
+        if not step_p50:
+            return 1
+        gangs_ahead = -(-self._depth // self.max_batch)  # ceil
+        est = gangs_ahead * self.max_new_tokens * (step_p50 / 1e3)
+        return max(1, min(int(cap), int(est + 0.999)))
+
     def stats(self):
         """Zero-recompile evidence + occupancy, BucketScheduler-shaped
         (``compiles`` = fresh XLA only; warm restarts show 0)."""
@@ -513,5 +545,6 @@ class DecodeScheduler:
             "kv_utilization": pool["utilization"],
             "max_prompt_len": self.max_prompt_len,
             "max_new_tokens": self.max_new_tokens,
+            "ready": self.ready,
             "closed": self._closed,
         }

@@ -117,6 +117,9 @@ class ServingMetrics:
         self.model = model
         self.registry = registry or REGISTRY
         self.latency = LatencyWindow()
+        # dispatch-side batch wall time: what a queued request actually
+        # waits per batch ahead of it — the Retry-After estimator's input
+        self.batch_latency = LatencyWindow(512)
         self._lock = threading.Lock()
         self._t0 = time.time()
         # baseline at construction: the registry series are process-
@@ -188,6 +191,7 @@ class ServingMetrics:
         self._c["batches"].inc()
         self._c["batch_rows"].inc(int(rows))
         self._c["padded_rows"].inc(int(bucket) - int(rows))
+        self.batch_latency.record(seconds)
         extra = {"links": links} if links else {}
         events.span("serving.batch", seconds, model=self.model,
                     bucket=int(bucket), rows=int(rows),
@@ -233,6 +237,7 @@ class ServingMetrics:
             "rows_per_batch": round(filled / counters["batches"], 2)
             if counters["batches"] else None,
             "latency": self.latency.summary(),
+            "batch_latency": self.batch_latency.summary(),
         })
         return out
 

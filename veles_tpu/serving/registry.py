@@ -25,11 +25,13 @@ class ServedModel:
     returned verbatim.
     """
 
-    def __init__(self, name, scheduler, transform=None, source=None):
+    def __init__(self, name, scheduler, transform=None, source=None,
+                 version=None):
         self.name = name
         self.scheduler = scheduler
         self.transform = transform
         self.source = source
+        self.version = version
         self.created = time.time()
 
     def infer(self, batch, timeout=None):
@@ -46,6 +48,8 @@ class ServedModel:
     def describe(self):
         stats = self.scheduler.stats()
         return {"source": self.source,
+                "version": self.version,
+                "ready": stats["ready"],
                 "sample_shape": list(self.scheduler.sample_shape)
                 if self.scheduler.sample_shape is not None else None,
                 "buckets": stats["buckets"],
@@ -60,10 +64,11 @@ class DecodeServedModel:
 
     kind = "decode"
 
-    def __init__(self, name, scheduler, source=None):
+    def __init__(self, name, scheduler, source=None, version=None):
         self.name = name
         self.scheduler = scheduler
         self.source = source
+        self.version = version
         self.created = time.time()
 
     def generate(self, prompt, max_new_tokens=None, timeout=None):
@@ -74,6 +79,8 @@ class DecodeServedModel:
     def describe(self):
         stats = self.scheduler.stats()
         return {"source": self.source,
+                "version": self.version,
+                "ready": stats["ready"],
                 "kind": "decode",
                 "max_prompt_len": stats["max_prompt_len"],
                 "max_new_tokens": stats["max_new_tokens"],
@@ -103,15 +110,23 @@ class ModelRegistry:
         self._scheduler_defaults = scheduler_defaults
 
     def add(self, name, model, transform=None, default=False,
-            metrics=None, **scheduler_kwargs):
+            metrics=None, version=None, **scheduler_kwargs):
         """Register a model (workflow / package path / PackageLoader /
         callable) under ``name``; compiles its bucket ladder now so the
         first request is already warm.  A decode adapter (anything with
         the ``prefill_fn``/``decode_fn``/``make_pools`` trio) routes to
-        :meth:`add_decode` instead."""
+        :meth:`add_decode` instead.
+
+        Re-adding an existing ``name`` is the HOT-LOAD path: the new
+        entry (optionally tagged ``version``) warms fully before the
+        swap, the swap itself is one dict write under the lock, and the
+        replaced scheduler drains — in-flight requests against the old
+        version complete normally, so a rolling fleet update never
+        drops a response."""
         if _is_decode_model(model):
             return self.add_decode(name, model, default=default,
-                                   metrics=metrics, **scheduler_kwargs)
+                                   metrics=metrics, version=version,
+                                   **scheduler_kwargs)
         source = model if isinstance(model, str) else type(model).__name__
         kwargs = dict(self._scheduler_defaults)
         kwargs.update(scheduler_kwargs)
@@ -119,11 +134,11 @@ class ModelRegistry:
             model, name=name,
             metrics=metrics or ServingMetrics(name), **kwargs)
         entry = ServedModel(name, scheduler, transform=transform,
-                            source=source)
+                            source=source, version=version)
         return self._install(name, entry, default)
 
     def add_decode(self, name, model, default=False, metrics=None,
-                   **decode_kwargs):
+                   version=None, **decode_kwargs):
         """Register a decode adapter under ``name`` — warms its decode
         executable and prefill ladder now, serves
         ``POST /api/<name>/generate``."""
@@ -140,7 +155,8 @@ class ModelRegistry:
             model, name=name,
             metrics=metrics or DecodeMetrics(name), **kwargs)
         entry = DecodeServedModel(name, scheduler,
-                                  source=type(model).__name__)
+                                  source=type(model).__name__,
+                                  version=version)
         return self._install(name, entry, default)
 
     def _install(self, name, entry, default):
@@ -188,6 +204,21 @@ class ModelRegistry:
     @property
     def default_name(self):
         return self._default
+
+    def ready(self):
+        """True when at least one model is registered and EVERY
+        registered scheduler finished its warmup ladder — what
+        ``GET /readyz`` (and through it the fleet router) gates on."""
+        with self._lock:
+            entries = list(self._models.values())
+        return bool(entries) and all(e.scheduler.ready for e in entries)
+
+    def load_snapshot(self):
+        """Per-model backpressure signals (cheap — no latency sorts),
+        the router's least-loaded dispatch input."""
+        with self._lock:
+            entries = list(self._models.items())
+        return {name: entry.scheduler.load() for name, entry in entries}
 
     def describe(self):
         with self._lock:

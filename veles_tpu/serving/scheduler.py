@@ -509,6 +509,37 @@ class BucketScheduler:
     def queue_depth(self):
         return self._depth
 
+    @property
+    def ready(self):
+        """True once the warmup ladder is fully compiled (background
+        tail included) and the scheduler is accepting — the signal
+        behind ``GET /readyz`` and fleet-router admission."""
+        if self._closed or not self._executables:
+            return False
+        t = self._warmup_thread
+        return t is None or not t.is_alive()
+
+    def load(self):
+        """Cheap backpressure snapshot for routers: no locks beyond
+        int reads, safe to poll at high frequency."""
+        depth = self._depth
+        return {"kind": "bucket",
+                "queue_depth": depth,
+                "queue_limit": self.queue_limit,
+                "utilization": round(depth / self.queue_limit, 4)}
+
+    def retry_after_s(self, cap=30):
+        """Seconds until the current backlog plausibly drains: queued
+        batches ahead x the recent per-batch wall time, spread over the
+        dispatch workers.  The shed response's ``Retry-After`` — a
+        computed hint instead of the old hardcoded ``1``."""
+        batch_p50 = self.metrics.batch_latency.summary().get("p50_ms")
+        if not batch_p50:
+            return 1
+        batches_ahead = -(-self._depth // self.max_batch)  # ceil
+        est = batches_ahead * (batch_p50 / 1e3) / len(self._workers)
+        return max(1, min(int(cap), int(est + 0.999)))
+
     def join_warmup(self, timeout=None):
         """Block until a background warmup tail finishes (no-op when
         warmup was synchronous).  Returns True when nothing is left
@@ -541,5 +572,6 @@ class BucketScheduler:
             "queue_limit": self.queue_limit,
             "max_batch": self.max_batch,
             "workers": len(self._workers),
+            "ready": self.ready,
             "closed": self._closed,
         }

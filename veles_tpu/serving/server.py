@@ -20,9 +20,18 @@ Endpoints:
     POST /api/<model>              infer on a named model
     POST /api/<model>/generate     autoregressive decode (token-level
                                    continuous batching; decode models)
-    GET  /healthz        liveness + model listing
+    POST /admin/models   hot-load a model version (``enable_admin`` only)
+    GET  /healthz        pure liveness + model listing
+    GET  /readyz         readiness: 503 until every model's warmup
+                         ladder (and decode prefill ladder) is compiled,
+                         200 after — what a fleet router gates admission
+                         on; the body carries the per-model load signals
     GET  /metrics        per-model latency/throughput/batching snapshot
     GET  /models         registry description
+
+Load shedding answers 429 with a ``Retry-After`` computed from the
+scheduler's queue depth and its recent batch latency (one shared helper
+— the hint used to be hardcoded to ``1``).
 
 Shutdown is a graceful drain: stop accepting, finish every queued
 request, then stop the dispatch workers.
@@ -53,6 +62,9 @@ class _ServingHandler(JsonRequestHandler):
     # -- routes --------------------------------------------------------------
     def do_POST(self):
         path = self.path.split("?", 1)[0].rstrip("/")
+        if path == "/admin/models":
+            self._admin_load()
+            return
         if path != "/api" and not path.startswith("/api/"):
             self.send_json(404, {"error": "not found"})
             return
@@ -68,17 +80,86 @@ class _ServingHandler(JsonRequestHandler):
         srv = self.server_ref
         path = self.path.split("?", 1)[0].rstrip("/")
         if path == "/healthz":
+            # pure liveness: answers "ok" even while warming or
+            # draining — process-up is a different question from
+            # accepting-traffic (that's /readyz)
             self.send_json(200, {
                 "status": "draining" if srv.draining else "ok",
                 "models": srv.registry.names(),
                 "default_model": srv.registry.default_name,
                 "uptime_s": round(time.time() - srv.started, 1)})
+        elif path == "/readyz":
+            ready = srv.registry.ready() and not srv.draining
+            self.send_json(200 if ready else 503, {
+                "ready": ready,
+                "draining": srv.draining,
+                "models": {name: entry.scheduler.ready
+                           for name, entry in
+                           ((n, srv.registry.get(n))
+                            for n in srv.registry.names())
+                           if entry is not None},
+                "load": srv.registry.load_snapshot()})
         elif path == "/metrics":
             self.send_json(200, srv.registry.metrics_snapshot())
         elif path == "/models":
             self.send_json(200, srv.registry.describe())
         else:
             self.send_json(404, {"error": "not found"})
+
+    # -- load shedding -------------------------------------------------------
+    def _shed(self, entry, message, close=False, trace_hdr=None):
+        """The ONE shed-response constructor: 429 + a ``Retry-After``
+        computed from the scheduler's queue depth and recent batch
+        latency (was three copies of a hardcoded ``"1"``)."""
+        try:
+            retry = entry.scheduler.retry_after_s()
+        except Exception:  # noqa: BLE001 — a hint must never 500 a shed
+            retry = 1
+        headers = {"Retry-After": str(int(retry)), **(trace_hdr or {})}
+        if close:
+            headers["Connection"] = "close"
+        self.send_json(429, {"error": message, "model": entry.name,
+                             "retry_after_s": int(retry)},
+                       headers=headers)
+        return 429
+
+    # -- admin: versioned hot-load -------------------------------------------
+    def _admin_load(self):
+        """``POST /admin/models {"name", "model", "version"?,
+        "default"?}`` → registry hot-load (the rolling-update hook).
+        404 unless the server was built with ``enable_admin`` — a plain
+        InferenceServer keeps the seed surface."""
+        srv = self.server_ref
+        if not srv.enable_admin:
+            self.send_json(404, {"error": "not found"})
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            payload = json.loads(self.rfile.read(length))
+            if not isinstance(payload, dict) or \
+                    not payload.get("name") or "model" not in payload:
+                raise ValueError
+            name = str(payload["name"])
+            spec = payload["model"]
+        except ValueError:
+            self.send_json(400, {
+                "error": "body must be {'name': ..., 'model': "
+                         "<package path or spec>, 'version'?: ...}"})
+            return
+        try:
+            model = (srv.model_resolver(spec)
+                     if srv.model_resolver is not None else spec)
+            entry = srv.registry.add(
+                name, model, version=payload.get("version"),
+                default=bool(payload.get("default", False)))
+        except Exception as exc:  # noqa: BLE001 — report, keep serving
+            log.exception("admin hot-load of %r failed", name)
+            self.send_json(500, {"error": "hot-load failed: %s"
+                                 % str(exc)[:300], "model": name})
+            return
+        self.send_json(200, {"model": entry.name,
+                             "version": entry.version,
+                             "ready": entry.scheduler.ready})
 
     # -- the inference path --------------------------------------------------
     def _infer(self, name):
@@ -117,10 +198,8 @@ class _ServingHandler(JsonRequestHandler):
         try:
             result, out = entry.infer(batch, timeout=srv.request_timeout)
         except SchedulerOverflow as e:
-            self.send_json(429, {"error": "server overloaded: %s" % e,
-                                 "model": entry.name},
-                           headers={"Retry-After": "1", **trace_hdr})
-            return 429
+            return self._shed(entry, "server overloaded: %s" % e,
+                              trace_hdr=trace_hdr)
         except SchedulerClosed:
             self.send_json(503, {"error": "server is draining"},
                            headers={"Connection": "close", **trace_hdr})
@@ -195,19 +274,14 @@ class _ServingHandler(JsonRequestHandler):
             result = entry.generate(prompt, max_new,
                                     timeout=srv.request_timeout)
         except SchedulerOverflow as e:
-            self.send_json(429, {"error": "server overloaded: %s" % e,
-                                 "model": entry.name},
-                           headers={"Retry-After": "1", **trace_hdr})
-            return 429
+            return self._shed(entry, "server overloaded: %s" % e,
+                              trace_hdr=trace_hdr)
         except SchedulerClosed:
             # drain: in-flight sequences finish, NEW generate submits
             # shed with retryable backpressure (429 + Retry-After), so
             # a well-behaved client re-resolves to another replica
-            self.send_json(429, {"error": "server is draining",
-                                 "model": entry.name},
-                           headers={"Retry-After": "1",
-                                    "Connection": "close", **trace_hdr})
-            return 429
+            return self._shed(entry, "server is draining", close=True,
+                              trace_hdr=trace_hdr)
         except Exception:
             error_id = uuid.uuid4().hex[:12]
             log.exception("generate failed on model %r (error id %s)",
@@ -232,11 +306,17 @@ class InferenceServer:
 
     def __init__(self, models=None, registry=None, port=0,
                  host="127.0.0.1", request_timeout=60.0,
+                 enable_admin=False, model_resolver=None,
                  **scheduler_defaults):
         self.registry = registry or ModelRegistry(**scheduler_defaults)
         self.request_timeout = request_timeout
         self.started = time.time()
         self.draining = False
+        # the hot-load endpoint is opt-in (fleet replicas turn it on);
+        # model_resolver maps an admin "model" spec to something the
+        # registry accepts (the fleet replica's sleep:/package resolver)
+        self.enable_admin = bool(enable_admin)
+        self.model_resolver = model_resolver
         if models:
             items = models.items() if hasattr(models, "items") else models
             for name, model in items:
