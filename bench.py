@@ -77,6 +77,15 @@ Also reported in the same JSON line:
   plus the SIGKILL and rolling-update drills under open-loop load
   (zero non-429 failures = the zero-downtime evidence; respawn
   ``compiles == 0`` = the warm-spawn evidence).
+- ``graph_nonstd_speedup`` + ``graph_nonstd_{interpreted,traced}_ips`` +
+  ``graph_std_traced_vs_fused`` + ``graph_std_traced_vs_interpreted`` +
+  ``graph_{cold,warm}_compiles`` — whole-workflow compilation (ISSUE 8,
+  tools/graph_bench.py): a deliberately non-standard two-branch DAG
+  (not expressible by ``FusedTrainStep``) interpreted vs traced into
+  one compiled program per step (acceptance >= 1.5x), the standard
+  MNIST topology traced vs the hand-fused step (no-regression proof),
+  and a cold→warm traced-restart pair over one compile-cache dir
+  (``graph_warm_compiles == 0`` = the zero-recompile evidence).
 - ``snapshot_stall_speedup`` + ``snapshot_stall_{sync,async}_ms`` +
   ``snapshot_write_gz{9,6}_ms`` — the checkpointing path (ISSUE 4):
   per-snapshot training-thread stall on the MNIST step loop with the
@@ -801,6 +810,51 @@ def bench_fleet(replicas=3, probe_timeout=360):
     return {k: line.get(k) for k in keys}
 
 
+def bench_graph_compile(probe_timeout=150):
+    """Whole-workflow compilation (ISSUE 8 acceptance: a non-standard
+    two-branch workflow traced >= 1.5x its interpreted throughput, the
+    standard MNIST topology traced >= the hand-fused step, and a warm
+    restart of a traced workflow doing ZERO XLA compiles).  Each probe
+    is a FRESH subprocess (tools/graph_bench.py); the warm pair shares
+    one cache dir — the second process IS the restart being measured."""
+    import subprocess
+    import tempfile
+    _stamp("graph-compile stage")
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "graph_bench.py")
+    cache_dir = os.path.join(
+        tempfile.mkdtemp(prefix="veles-graph-bench-"), "compile_cache")
+
+    def probe(name, *extra):
+        argv = [sys.executable, tool, "--probe", name] + list(extra)
+        proc = subprocess.run(argv, capture_output=True,
+                              timeout=probe_timeout)
+        line = _last_json_line(proc.stdout.decode())
+        if line is None:
+            raise RuntimeError("graph_bench probe %s failed: %s"
+                               % (name, proc.stderr.decode()[-400:]))
+        return line
+
+    out = {}
+    out.update(probe("nonstd"))
+    _stamp("graph-compile nonstd: %sx traced vs interpreted (bitwise=%s)"
+           % (out.get("graph_nonstd_speedup"),
+              out.get("graph_nonstd_bitwise_n_err")))
+    out.update(probe("std"))
+    _stamp("graph-compile std: traced/fused %s traced/interpreted %s"
+           % (out.get("graph_std_traced_vs_fused"),
+              out.get("graph_std_traced_vs_interpreted")))
+    cold = probe("warm", "--cache-dir", cache_dir)
+    warm = probe("warm", "--cache-dir", cache_dir)
+    out["graph_cold_compiles"] = cold["graph_compiles"]
+    out["graph_warm_compiles"] = warm["graph_compiles"]
+    out["graph_warm_cache_hits"] = warm["graph_cache_hits"]
+    _stamp("graph-compile warm restart: compiles %s (cold %s), hits %s"
+           % (warm["graph_compiles"], cold["graph_compiles"],
+              warm["graph_cache_hits"]))
+    return out
+
+
 def bench_observability(batch=512, steps=64, repeats=5):
     """Tracing+metrics overhead on the MNIST per-step loop (ISSUE 2
     acceptance: < 5%): the SAME per-launch step loop timed bare, then
@@ -1039,6 +1093,8 @@ def _stage_main(stage):
         out = bench_decode()
     elif stage == "fleet":
         out = bench_fleet()
+    elif stage == "graph_compile":
+        out = bench_graph_compile()
     else:
         raise SystemExit("unknown stage %r" % stage)
     out["spread"] = SPREAD
@@ -1097,6 +1153,12 @@ STAGE_PLAN = [
     # and rolling-update error rate (ISSUE 7) — one fresh subprocess
     # owning router + N replica grandchildren under a hard cap
     ("fleet", 420),
+    # whole-workflow compilation (ISSUE 8): the non-standard two-branch
+    # DAG interpreted vs traced (>= 1.5x acceptance), the standard MNIST
+    # topology traced vs hand-fused (no-regression proof), and the
+    # cold/warm traced-restart pair over one cache dir (warm compiles
+    # == 0) — four fresh subprocesses a la decode/fleet
+    ("graph_compile", 420),
 ]
 
 

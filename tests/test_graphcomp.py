@@ -1,0 +1,545 @@
+"""Whole-workflow compilation (veles_tpu.graphcomp): bitwise parity of
+traced vs interpreted dispatch, gate semantics under tracing, fallback
+behavior, snapshot safety, warm-restart zero-compile, and the debugging/
+observability faces (dump_graph, gauges, StepProfiler)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.loader.base import TEST, VALID, TRAIN
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.mutable import Bool
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+class BlobLoader(FullBatchLoader):
+    def load_data(self):
+        rng = numpy.random.RandomState(4)
+        centers = rng.uniform(-2, 2, (4, 8))
+        data, labels = [], []
+        for c in range(4):
+            data.append(centers[c] + 0.9 * rng.standard_normal((50, 8)))
+            labels += [c] * 50
+        data = numpy.concatenate(data).astype(numpy.float32)
+        order = rng.permutation(len(data))
+        self.original_data.mem = data[order]
+        self.original_labels = list(numpy.array(labels)[order])
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = 50
+        self.class_lengths[TRAIN] = 150
+
+
+LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 20},
+     "<-": {"learning_rate": 0.2, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 4},
+     "<-": {"learning_rate": 0.2, "gradient_moment": 0.9}},
+]
+
+
+def build(graph_compile, max_epochs=3, seed=77, minibatch=25,
+          fused=False, **extra):
+    import veles_tpu.prng.random_generator as rg
+    rg._generators.clear()
+    rg.get(0).seed(seed)
+    wf = StandardWorkflow(
+        None, name="gcwf",
+        loader_factory=BlobLoader,
+        loader={"minibatch_size": minibatch,
+                "prng": RandomGenerator().seed(5)},
+        layers=LAYERS, loss_function="softmax",
+        decision={"max_epochs": max_epochs, "silent": True},
+        fused=fused, graph_compile=graph_compile, **extra)
+    wf.initialize(device=Device(backend="cpu"))
+    return wf
+
+
+def assert_bitwise(wf_a, wf_b, solver=True):
+    """Weights, biases, solver state, and decision metrics must be
+    BIT-IDENTICAL between the two runs."""
+    for fa, fb in zip(wf_a.forwards, wf_b.forwards):
+        for name in ("weights", "bias"):
+            a = numpy.asarray(getattr(fa, name).map_read())
+            b = numpy.asarray(getattr(fb, name).map_read())
+            assert numpy.array_equal(a, b), (type(fa).__name__, name)
+    if solver:
+        for ga, gb in zip(wf_a.gds, wf_b.gds):
+            assert set(ga.solver_state) == set(gb.solver_state)
+            for name in ga.solver_state:
+                for sa, sb in zip(ga.solver_state[name],
+                                  gb.solver_state[name]):
+                    assert numpy.array_equal(numpy.asarray(sa),
+                                             numpy.asarray(sb)), name
+    da, db = wf_a.decision, wf_b.decision
+    for attr in ("epoch_n_err", "epoch_n_err_pt", "best_n_err",
+                 "best_n_err_pt", "best_epoch"):
+        if hasattr(da, attr):
+            assert getattr(da, attr) == getattr(db, attr), attr
+
+
+# -- parity: workflow shape 1, the standard softmax chain ---------------------
+
+def test_traced_equals_interpreted_softmax_chain():
+    wf_i, wf_t = build(False), build(True)
+    controller = wf_t.graph_controller
+    assert controller is not None
+    assert controller.traced_unit_count == 5   # 2 fwd + eval + 2 gd
+    wf_i.run()
+    wf_t.run()
+    assert_bitwise(wf_i, wf_t)
+    cm_i = numpy.asarray(wf_i.evaluator.confusion_matrix.map_read())
+    cm_t = numpy.asarray(wf_t.evaluator.confusion_matrix.map_read())
+    assert cm_i.sum() == cm_t.sum() > 0
+    assert numpy.array_equal(cm_i, cm_t)
+    assert int(wf_i.evaluator.n_err[0]) == int(wf_t.evaluator.n_err[0])
+    stats = controller.stats()
+    assert stats["flushes"] > 0 and stats["variants"] > 0
+    assert not stats["disabled"]
+
+
+def test_traced_equals_interpreted_uneven_minibatch():
+    """Partial tail minibatches key separate static variants; results
+    stay bitwise-identical."""
+    wf_i = build(False, minibatch=40, max_epochs=2, seed=99)
+    wf_t = build(True, minibatch=40, max_epochs=2, seed=99)
+    wf_i.run()
+    wf_t.run()
+    assert_bitwise(wf_i, wf_t)
+    assert wf_t.graph_controller.stats()["variants"] >= 4  # full+tail x2
+
+
+# -- parity: workflow shape 2, the MSE regression chain -----------------------
+
+MSE_LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+     "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+    {"type": "all2all", "->": {"output_sample_shape": 3},
+     "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+]
+
+
+class RegressionLoader:
+    def __new__(cls, workflow, **kwargs):
+        from veles_tpu.loader.fullbatch import FullBatchLoaderMSE
+
+        class _Loader(FullBatchLoaderMSE):
+            hide_from_registry = True
+
+            def load_data(self):
+                rng = numpy.random.RandomState(11)
+                x = rng.uniform(-1, 1, (200, 6)).astype(numpy.float32)
+                w = rng.standard_normal((6, 3)).astype(numpy.float32)
+                t = numpy.tanh(x @ w) + 0.05 * rng.standard_normal(
+                    (200, 3)).astype(numpy.float32)
+                self.original_data.mem = x
+                self.original_targets.mem = t.astype(numpy.float32)
+                self.class_lengths[TEST] = 0
+                self.class_lengths[VALID] = 50
+                self.class_lengths[TRAIN] = 150
+        return _Loader(workflow, **kwargs)
+
+
+def test_traced_equals_interpreted_mse():
+    """MSE shape: weights and solver state bitwise; the decision rmse
+    agrees to float32 precision (metrics accumulate on device in f32 vs
+    the host evaluator's f64 — documented in COMPONENTS.md)."""
+    import veles_tpu.prng.random_generator as rg
+    results = {}
+    for gc in (False, True):
+        rg._generators.clear()
+        rg.get(0).seed(13)
+        wf = StandardWorkflow(
+            None, name="gcmse", loader_factory=RegressionLoader,
+            loader={"minibatch_size": 40,
+                    "prng": RandomGenerator().seed(5)},
+            layers=MSE_LAYERS, loss_function="mse",
+            decision={"max_epochs": 3, "silent": True},
+            fused=False, graph_compile=gc)
+        wf.initialize(device=Device(backend="cpu"))
+        wf.run()
+        results[gc] = wf
+    wf_i, wf_t = results[False], results[True]
+    for fa, fb in zip(wf_i.forwards, wf_t.forwards):
+        for name in ("weights", "bias"):
+            assert numpy.array_equal(
+                numpy.asarray(getattr(fa, name).map_read()),
+                numpy.asarray(getattr(fb, name).map_read()))
+    for ga, gb in zip(wf_i.gds, wf_t.gds):
+        for name in ga.solver_state:
+            for sa, sb in zip(ga.solver_state[name],
+                              gb.solver_state[name]):
+                assert numpy.array_equal(numpy.asarray(sa),
+                                         numpy.asarray(sb))
+    assert wf_i.decision.best_rmse == pytest.approx(
+        wf_t.decision.best_rmse, rel=1e-5)
+
+
+# -- parity: workflow shape 3, the non-standard two-branch DAG ----------------
+
+def build_two_branch(**kwargs):
+    from graph_bench import build_two_branch as _build
+    kwargs.setdefault("n_train", 384)
+    kwargs.setdefault("n_valid", 96)
+    kwargs.setdefault("minibatch", 32)
+    kwargs.setdefault("max_epochs", 3)
+    return _build(**kwargs)
+
+
+def test_two_branch_single_region_and_parity():
+    """The two-branch + shared-evaluator DAG — not expressible by
+    FusedTrainStep — traces into ONE region / ONE program per step, with
+    n_err bitwise-equal to interpreted dispatch."""
+    wf_i = build_two_branch(graph_compile=False)
+    wf_t = build_two_branch(graph_compile=True)
+    controller = wf_t.graph_controller
+    assert controller.traced_unit_count == 7
+    assert len([r for r in controller.plan.regions
+                if r.kind == "traced"]) == 1
+    wf_i.run()
+    wf_t.run()
+    assert int(wf_i["EvaluatorSoftmax"].n_err[0]) == \
+        int(wf_t["EvaluatorSoftmax"].n_err[0]) > 0
+    head_i = numpy.asarray(wf_i["Head"].output.map_read())
+    head_t = numpy.asarray(wf_t["Head"].output.map_read())
+    assert numpy.array_equal(head_i, head_t)
+    # one program per minibatch in steady state (plus the valid-class
+    # variant): every member output still reads as interpreted would
+    assert controller.stats()["variants"] <= 2
+
+
+# -- gate semantics under tracing ---------------------------------------------
+
+def _gate_workflows(kind):
+    """Two identical two-branch workflows with a gate applied to one
+    tower, interpreted + traced."""
+    wfs = []
+    for gc in (False, True):
+        wf = build_two_branch(graph_compile=gc)
+        unit = wf["tower1_down"]
+        loader = wf.loader
+        if kind == "skip_const":
+            unit.gate_skip = Bool(True)
+        elif kind == "block_const":
+            # block a SIDE branch: tower1_down still fires (the joiner
+            # needs it) but an extra probe unit is blocked outright
+            probe = _attach_probe(wf)
+            probe.gate_block = Bool(True)
+        elif kind == "skip_flipping":
+            # flips WITHIN each epoch: first half of the offsets skip
+            half = loader.total_samples // 2
+            unit.gate_skip = Bool.from_callable(
+                lambda ld=loader: ld.minibatch_offset <= half)
+        elif kind == "block_flipping":
+            probe = _attach_probe(wf)
+            half = loader.total_samples // 2
+            probe.gate_block = Bool.from_callable(
+                lambda ld=loader: ld.minibatch_offset <= half)
+        wfs.append(wf)
+    return wfs
+
+
+def _attach_probe(wf):
+    """A side-branch forward off the loader whose output nothing reads —
+    exercises gate_block without deadlocking the AND-gates."""
+    from veles_tpu.znicz.all2all import All2AllTanh
+    probe = All2AllTanh(wf, output_sample_shape=8, name="SideProbe")
+    probe.link_from(wf.loader)
+    probe.link_attrs(wf.loader, ("input", "minibatch_data"))
+    probe.initialize(device=wf.device)
+    return probe
+
+
+@pytest.mark.parametrize("kind", ["skip_const", "block_const",
+                                  "skip_flipping", "block_flipping"])
+def test_gate_semantics_traced_equals_interpreted(kind):
+    wf_i, wf_t = _gate_workflows(kind)
+    if kind in ("block_const", "block_flipping"):
+        # probe attached after initialize: re-attach tracing so the new
+        # unit is part of the plan
+        wf_t.attach_graph_compiler()
+    wf_i.run()
+    wf_t.run()
+    assert int(wf_i["EvaluatorSoftmax"].n_err[0]) == \
+        int(wf_t["EvaluatorSoftmax"].n_err[0])
+    for name in ("Head", "tower1_down", "tower0_down"):
+        a = numpy.asarray(wf_i[name].output.map_read())
+        b = numpy.asarray(wf_t[name].output.map_read())
+        assert numpy.array_equal(a, b), name
+    if kind.startswith("skip"):
+        # the skipped tower's output stayed stale identically
+        pass
+    if kind == "skip_flipping":
+        # flipping gates key MULTIPLE variants, never an error
+        assert wf_t.graph_controller.stats()["variants"] >= 2
+    if kind in ("block_const", "block_flipping"):
+        a = numpy.asarray(wf_i["SideProbe"].output.map_read())
+        b = numpy.asarray(wf_t["SideProbe"].output.map_read())
+        assert numpy.array_equal(a, b)
+
+
+# -- fallback: an untraceable unit mid-chain ----------------------------------
+
+def test_untraceable_unit_splits_region_and_stays_correct():
+    """A host-side unit spliced mid-chain becomes a region boundary with
+    a recorded reason; results match interpreted dispatch exactly."""
+    from veles_tpu.units import Unit
+
+    class HostClip(Unit):
+        """Numpy-side clamp — no trace face on purpose."""
+
+        def __init__(self, workflow, **kwargs):
+            super().__init__(workflow, **kwargs)
+            from veles_tpu.memory import Array
+            self.input = None
+            self.output = Array()
+
+        def run(self):
+            x = numpy.asarray(self.input.map_read())
+            self.output.mem = numpy.clip(x, -0.5, 0.5)
+
+    def build_with_clip(gc):
+        wf = build_two_branch(graph_compile=False)
+        tower = wf["tower0_down"]
+        head_src = wf["InputJoiner"]
+        clip = HostClip(wf, name="HostClip")
+        # splice: tower0_down -> clip -> joiner
+        head_src.unlink_from(tower)
+        clip.link_from(tower)
+        clip.link_attrs(tower, ("input", "output"))
+        head_src.link_from(clip)
+        from veles_tpu.mutable import link_attribute
+        link_attribute(head_src, "input_0", clip, "output")
+        clip.output.mem = numpy.zeros_like(
+            numpy.asarray(tower.output.map_read()))
+        clip._initialized = True
+        if gc:
+            wf.attach_graph_compiler()
+        return wf
+
+    wf_i, wf_t = build_with_clip(False), build_with_clip(True)
+    controller = wf_t.graph_controller
+    reasons = dict((u.name, r) for u, r in
+                   controller.plan.fallback_units)
+    assert "HostClip" in reasons
+    assert "no pure trace face" in reasons["HostClip"]
+    assert len([r for r in controller.plan.regions
+                if r.kind == "traced"]) >= 2
+    wf_i.run()
+    wf_t.run()
+    assert not controller.stats()["disabled"]
+    assert int(wf_i["EvaluatorSoftmax"].n_err[0]) == \
+        int(wf_t["EvaluatorSoftmax"].n_err[0])
+    assert numpy.array_equal(
+        numpy.asarray(wf_i["Head"].output.map_read()),
+        numpy.asarray(wf_t["Head"].output.map_read()))
+
+
+# -- pre-fused paths under the knob -------------------------------------------
+
+def test_fused_standard_with_graph_compile_is_bitwise_and_precompiled():
+    wf_a = build(False, fused=True)
+    wf_b = build(True, fused=True)
+    controller = wf_b.graph_controller
+    assert controller is not None
+    kinds = [r.kind for r in controller.plan.regions]
+    assert kinds == ["precompiled"]
+    wf_a.run()
+    wf_b.run()
+    for fa, fb in zip(wf_a.forwards, wf_b.forwards):
+        assert numpy.array_equal(
+            numpy.asarray(fa.weights.map_read()),
+            numpy.asarray(fb.weights.map_read()))
+    assert wf_a.decision.epoch_n_err == wf_b.decision.epoch_n_err
+
+
+def test_epoch_scan_composes_with_graph_compile():
+    wf_a = build(False, fused=True, epoch_scan=True)
+    wf_b = build(True, fused=True, epoch_scan=True)
+    assert [r.kind for r in wf_b.graph_controller.plan.regions] == \
+        ["precompiled"]
+    wf_a.run()
+    wf_b.run()
+    for fa, fb in zip(wf_a.forwards, wf_b.forwards):
+        assert numpy.array_equal(
+            numpy.asarray(fa.weights.map_read()),
+            numpy.asarray(fb.weights.map_read()))
+
+
+# -- snapshot safety ----------------------------------------------------------
+
+def _snapshot_roundtrip(first_traced, then_traced, tmp_path, tag):
+    """Train 3 epochs with/without tracing, snapshot (on validation
+    improvement, i.e. MID-epoch between the valid and train classes),
+    restore on the opposite configuration, resume to 6 epochs."""
+    from veles_tpu.snapshotter import restore
+    sub = tmp_path / tag
+    sub.mkdir()
+    wf = build(first_traced, max_epochs=3,
+               snapshotter={"prefix": "gc", "directory": str(sub),
+                            "time_interval": 0, "compression": "gz"})
+    wf.run()
+    resumed = restore(str(sub / "gc_current"))
+    assert resumed.restored_from_snapshot
+    resumed.graph_compile = then_traced
+    resumed.decision.max_epochs = 6
+    resumed.initialize(device=Device(backend="cpu"))
+    if then_traced:
+        assert resumed.graph_controller is not None
+    else:
+        assert resumed.graph_controller is None
+    resumed.run()
+    return resumed
+
+
+def test_snapshot_traced_restores_on_interpreted_and_vice_versa(tmp_path):
+    """Acceptance: a workflow snapshotted with graph_compile on restores
+    and resumes on a process WITHOUT it (and vice versa) — and both
+    resume bitwise-identically to the never-traced baseline."""
+    base = _snapshot_roundtrip(False, False, tmp_path, "base")
+    on_off = _snapshot_roundtrip(True, False, tmp_path, "on_off")
+    off_on = _snapshot_roundtrip(False, True, tmp_path, "off_on")
+    for other in (on_off, off_on):
+        assert_bitwise(base, other, solver=False)
+        assert other.loader.epoch_number == base.loader.epoch_number
+
+
+def test_pickling_traced_workflow_syncs_carry():
+    """Workflow.__getstate__ under tracing syncs the live carry: a
+    pickle taken mid-training holds the CURRENT weights, not the
+    attach-time ones, and no controller/proxy internals leak in."""
+    import pickle
+    wf_t = build(True, max_epochs=2)
+    wf_i = build(False, max_epochs=2)
+    wf_t.run()
+    wf_i.run()
+    blob = pickle.dumps(wf_t)
+    clone = pickle.loads(blob)
+    for fc, fi in zip(clone.forwards, wf_i.forwards):
+        assert numpy.array_equal(
+            numpy.asarray(fc.weights.map_read()),
+            numpy.asarray(fi.weights.map_read()))
+    assert clone.graph_controller is None
+
+
+# -- warm restart: zero XLA compiles across processes -------------------------
+
+def test_warm_restart_zero_compiles_cross_process(tmp_path):
+    """Two fresh processes share one executable-cache dir: the second's
+    traced workflow performs ZERO XLA compiles (compile-cache stats()
+    proven in the subprocess)."""
+    cache_dir = str(tmp_path / "cc")
+    tool = os.path.join(REPO, "tools", "graph_bench.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def probe():
+        proc = subprocess.run(
+            [sys.executable, tool, "--probe", "warm",
+             "--cache-dir", cache_dir],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    cold = probe()
+    warm = probe()
+    assert cold["graph_compiles"] >= 1
+    assert cold["graph_cache_hits"] == 0
+    assert warm["graph_compiles"] == 0
+    assert warm["graph_cache_hits"] >= 1
+    assert warm["graph_variants"] == cold["graph_variants"]
+
+
+# -- debugging & observability faces ------------------------------------------
+
+def test_dump_graph_tool(tmp_path):
+    tool = os.path.join(REPO, "tools", "dump_graph.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, tool, "--sample", "mnist"],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    out = proc.stdout
+    assert "regions:" in out
+    assert "region 0 [traced" in out
+    assert "host-side loader" in out
+    assert "GDTanh" in out and "EvaluatorSoftmax" in out
+    assert "data links" in out
+
+
+def test_region_gauges_exported():
+    from veles_tpu.observability.registry import REGISTRY
+    wf = build(True)
+    wf.run()
+    text = REGISTRY.render_prometheus()
+    assert 'veles_graph_regions{workflow="gcwf"}' in text
+    assert 'veles_graph_fallback_units{workflow="gcwf"}' in text
+    assert "veles_graph_flushes_total" in text
+
+
+def test_step_profiler_wraps_traced_flush():
+    """StepProfiler on a traced workflow reports steps, phase slices and
+    recompile counts off the region flush — like the fused path."""
+    wf = build(True, max_epochs=2)
+    profiler = wf.attach_profiler()
+    assert profiler.step is wf.graph_controller
+    wf.run()
+    summary = profiler.summary()
+    assert summary["steps"] > 0
+    assert summary["examples"] > 0
+    # every compiled variant counted exactly once as a recompile
+    assert summary["recompiles"] == \
+        wf.graph_controller.stats()["compiles"]
+    assert set(summary["phase_pct"]) >= {"data_wait", "host", "device"}
+    profiler.detach()
+    controller = wf.graph_controller
+    assert controller is not None
+    controller.detach()
+    assert wf.graph_controller is None
+
+
+def test_detach_restores_interpreted_dispatch():
+    wf = build(True, max_epochs=2)
+    controller = wf.graph_controller
+    wf.run()
+    controller.detach()
+    # metric Arrays are plain again and units run interpreted
+    from veles_tpu.graphcomp import TracedStateArray
+    assert not isinstance(wf.evaluator.n_err, TracedStateArray)
+    wf.decision.max_epochs = 4
+    wf.decision.complete <<= False
+    wf.run()   # interpreted continuation must not crash
+    assert wf.loader.epoch_number >= 3
+
+
+def test_lr_adjustment_does_not_retrace():
+    """Per-epoch learning-rate changes ride as traced arguments: no new
+    variants, results still track the interpreted run bitwise."""
+    def with_lr_adjust(gc):
+        wf = build(gc, max_epochs=3)
+        from veles_tpu.znicz.lr_adjust import LearningRateAdjuster
+        adj = LearningRateAdjuster(wf, policy="exp", gamma=0.8)
+        adj.link_from(wf.decision)
+        adj.link_loader(wf.loader)
+        adj.link_gds(*wf.gds)
+        if gc:
+            wf.attach_graph_compiler()   # re-plan with the new unit
+        return wf
+
+    wf_i, wf_t = with_lr_adjust(False), with_lr_adjust(True)
+    wf_i.run()
+    wf_t.run()
+    assert_bitwise(wf_i, wf_t)
+    # train/valid x full/tail variants at most — lr changes added none
+    assert wf_t.graph_controller.stats()["variants"] <= 4

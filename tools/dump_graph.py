@@ -1,0 +1,66 @@
+"""Print a workflow's unit DAG, traced-region partition, and per-unit
+fallback reasons — the debugging face for "why didn't my unit fuse?".
+
+Builds one of the bundled demo workflows, initializes it on the current
+backend, runs whole-workflow graph analysis (:mod:`veles_tpu.graphcomp`)
+and prints the report.  With ``--run N`` it also executes N epochs under
+the graph compiler and appends the runtime stats (variants compiled,
+flushes, fallbacks).
+
+    python tools/dump_graph.py                       # MNIST, graph mode
+    python tools/dump_graph.py --sample mnist_fused  # the pre-fused path
+    python tools/dump_graph.py --sample two_branch   # non-standard DAG
+    python tools/dump_graph.py --run 2               # + runtime stats
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build(sample, epochs):
+    from veles_tpu.backends import Device
+    if sample == "two_branch":
+        from graph_bench import build_two_branch
+        return build_two_branch(n_train=512, n_valid=128,
+                                max_epochs=epochs or 1)
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.znicz.samples import mnist
+    wf = mnist.create_workflow(
+        fused=(sample == "mnist_fused"),
+        loader={"minibatch_size": 100, "n_train": 500, "n_valid": 100,
+                "use_fixture": False,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": epochs or 1, "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    return wf
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--sample", default="mnist",
+                        choices=("mnist", "mnist_fused", "two_branch"))
+    parser.add_argument("--run", type=int, default=0, metavar="EPOCHS",
+                        help="also run N epochs traced and print stats")
+    args = parser.parse_args(argv)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    wf = build(args.sample, args.run)
+    from veles_tpu.graphcomp import analyze
+    print(analyze(wf).describe())
+    if args.run:
+        controller = wf.graph_controller or wf.attach_graph_compiler()
+        if controller is None:
+            print("\n(graph compiler unsupported on this backend)")
+            return 1
+        wf.run()
+        print("\nruntime stats after %d epoch(s):" % args.run)
+        print(json.dumps(controller.stats(), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -257,6 +257,12 @@ root.update({
             "precision_level": 0,
             # preferred compute dtype on TPU
             "dtype": "float32",
+            # whole-workflow compilation (veles_tpu/graphcomp/): trace
+            # any link_from unit DAG into single compiled, donated XLA
+            # programs; host units (loaders, deciders, plotters) stay
+            # interpreted at region boundaries.  Default off: interpreted
+            # dispatch is exactly unchanged until the knob is flipped.
+            "graph_compile": False,
             # JAX's built-in persistent compilation cache, applied at
             # backend init (backends.py): one knob covers every jit the
             # executable cache (compilecache/) doesn't own.  None = off.

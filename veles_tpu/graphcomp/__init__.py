@@ -1,0 +1,17 @@
+"""Whole-workflow compilation: trace any ``link_from`` unit DAG into
+compiled XLA programs (ROADMAP item 3; the "full compilation of programs
+to TPUs" idiom of arXiv 1810.09868 applied to VELES dataflow graphs).
+
+Public surface:
+
+- :func:`analyze` — introspect an initialized workflow into a
+  :class:`~.partition.GraphPlan` (regions, fallback reasons, data edges);
+- :class:`GraphCompiler` — the runtime controller
+  (``Workflow.attach_graph_compiler()`` / ``root.common.engine
+  .graph_compile`` wire it up);
+- the face protocol (:mod:`.faces`) units implement via ``make_trace()``.
+"""
+
+from .faces import NoFace, OpaqueFace, StateLeaf, TraceFace   # noqa: F401
+from .partition import GraphPlan, analyze                     # noqa: F401
+from .runtime import GraphCompiler, TracedStateArray          # noqa: F401

@@ -1,0 +1,210 @@
+"""Trace faces: the pure per-step contract a unit exposes to the tracer.
+
+A *face* (:class:`TraceFace`) is the jit-able view of one unit's ``run()``:
+which linked attributes it reads (``inputs``), which host scalars select a
+compiled variant (``statics``), which attributes it produces (``outputs``),
+which persistent values thread through the compiled program as a donated
+carry (``state``), and the pure function tying them together.  The region
+compiler (:mod:`.runtime`) composes the faces of consecutively-fired units
+into ONE jitted program — so a face's ``fn`` must execute the numerically
+IDENTICAL operations the unit's own jitted path runs, which is what makes
+traced execution bitwise-equal to interpreted dispatch (asserted by
+tests/test_graphcomp.py).
+
+Units opt in by implementing ``make_trace()`` (see
+:meth:`veles_tpu.units.Unit.make_trace`); returning :class:`NoFace` with a
+reason keeps the unit host-side and documents *why* in ``tools/dump_graph``.
+Already-compiled step units (FusedTrainStep and kin) return
+:class:`OpaqueFace`: they ARE a traced region of one, executed natively.
+"""
+
+
+class NoFace:
+    """Marker: this unit stays host-side; ``reason`` is the debugging face
+    surfaced by ``tools/dump_graph.py`` and the fallback gauges."""
+
+    __slots__ = ("reason",)
+
+    def __init__(self, reason):
+        self.reason = reason
+
+    def __repr__(self):
+        return "<NoFace %s>" % self.reason
+
+
+class StateLeaf:
+    """One persistent carry value threaded through compiled programs.
+
+    - ``name``:  face-local binding (``fn`` sees ``state[name]``);
+    - ``key``:   process-global identity — faces of different units naming
+      the same key SHARE the value (a GD unit updates the params its
+      forward reads);
+    - ``init()``: build the initial device pytree (decoupled copies when
+      ``donate`` — donated buffers must never alias live unit Arrays);
+    - ``dirty()``: True when the host rewrote the backing attribute since
+      the tracer last synced, forcing a re-seed via ``init`` (a Decision
+      resetting ``n_err`` to 0, a restored snapshot's solver state);
+    - ``sync(value)``: boundary write-back into the owning unit (params →
+      forward Arrays, solver state → GD dicts); None for leaves whose
+      visibility is handled by a lazy Array proxy;
+    - ``donate``: thread through ``donate_argnums`` (params/solver);
+      metric accumulators stay undonated so materialized views stay valid;
+    - ``array``: optional ``(unit, attr)`` of a :class:`memory.Array` the
+      leaf shadows — the runtime swaps it for a materialize-on-read proxy.
+    """
+
+    __slots__ = ("name", "key", "init", "dirty", "sync", "donate", "array")
+
+    def __init__(self, name, key, init, dirty=None, sync=None, donate=True,
+                 array=None):
+        self.name = name
+        self.key = key
+        self.init = init
+        self.dirty = dirty or (lambda: False)
+        self.sync = sync
+        self.donate = donate
+        self.array = array
+
+
+class TraceFace:
+    """The pure face of one unit (see module docstring).
+
+    ``fn(state, inputs, statics) -> (state_updates, outputs)`` where every
+    argument/return is a dict keyed by the declared names.  ``config()``
+    returns a hashable fingerprint of closed-over hyperparameters — a
+    changed config keys a fresh compiled variant instead of silently
+    running stale math.
+    """
+
+    opaque = False
+
+    def __init__(self, unit, fn, inputs=(), statics=(), outputs=(),
+                 state=(), sync_attrs=(), config=None):
+        self.unit = unit
+        self.fn = fn
+        self.inputs = tuple(inputs)
+        self.statics = tuple(statics)
+        self.outputs = tuple(outputs)
+        self.state = tuple(state)
+        #: unit attrs mirrored only at boundary sync (weights/bias): a
+        #: non-member reading them forces a flush+sync first
+        self.sync_attrs = tuple(sync_attrs)
+        self._config = config
+
+    def config(self):
+        return self._config
+
+    def __repr__(self):
+        return "<TraceFace %s>" % self.unit.name
+
+
+class OpaqueFace(TraceFace):
+    """A unit that is ALREADY one compiled program (FusedTrainStep, the
+    scan/mesh steps).  It executes natively and is reported as its own
+    traced region — the hand-fused step becomes one producer of traced
+    regions instead of a special case."""
+
+    opaque = True
+
+    def __init__(self, unit, label):
+        super().__init__(unit, fn=None)
+        self.label = label
+
+
+# -- shared leaf builders ------------------------------------------------------
+
+def forward_params_leaf(fwd):
+    """Donated params carry for a ForwardBase unit, shared (by key) with
+    the GD unit that updates it.  Copies on seed and on sync: the live
+    carry is donated every step and must never alias the unit's Arrays."""
+
+    def init():
+        import jax.numpy as jnp
+        return {k: jnp.array(v) for k, v in fwd.params.items()}
+
+    def dirty():
+        arrays = [fwd.weights]
+        if fwd.include_bias and fwd.bias:
+            arrays.append(fwd.bias)
+        return any(a._host_dirty_ for a in arrays if a)
+
+    def sync(value):
+        import jax.numpy as jnp
+        fwd.set_params({k: jnp.array(v) for k, v in value.items()})
+
+    return StateLeaf("params", (id(fwd), "params"), init, dirty=dirty,
+                     sync=sync, donate=True)
+
+
+def gd_params_leaf(gd):
+    """Params carry for a GD unit with no linked forward (hand-built test
+    graphs): backed directly by the GD unit's weights/bias Arrays."""
+
+    def init():
+        import jax.numpy as jnp
+        return {k: jnp.array(v)
+                for k, v in gd._gather_params(host=False).items()}
+
+    def dirty():
+        arrays = [a for a in (gd.weights, gd.bias) if a]
+        return any(a._host_dirty_ for a in arrays)
+
+    def sync(value):
+        import jax.numpy as jnp
+        gd._store_params({k: jnp.array(v) for k, v in value.items()},
+                         host=False)
+
+    return StateLeaf("params", (id(gd), "params"), init, dirty=dirty,
+                     sync=sync, donate=True)
+
+
+def solver_state_leaf(gd, params_of):
+    """Solver-state carry for a GD unit.  Seeds from ``gd.solver_state``
+    when present (snapshot restore) else ``solver.init``; boundary sync
+    writes copies back into ``gd.solver_state`` — the same dict the
+    interpreted path and the snapshotter use — and records their ids so
+    an EXTERNAL rewrite (restore, rollback) is detected and re-seeded."""
+    synced = {}
+
+    def init():
+        import jax.numpy as jnp
+        state = {}
+        for name, p in params_of().items():
+            have = gd.solver_state.get(name)
+            if have:
+                state[name] = tuple(jnp.asarray(s) for s in have)
+            else:
+                state[name] = gd.solver.init(p, jnp)
+        return state
+
+    def dirty():
+        if not synced:
+            return False  # first use goes through init anyway
+        current = {n: id(v) for n, v in gd.solver_state.items()}
+        return current != synced
+
+    def sync(value):
+        import jax.numpy as jnp
+        synced.clear()
+        for name, st in value.items():
+            gd.solver_state[name] = tuple(jnp.array(s) for s in st)
+            synced[name] = id(gd.solver_state[name])
+
+    return StateLeaf("solver", (id(gd), "solver"), init, dirty=dirty,
+                     sync=sync, donate=True)
+
+
+def array_state_leaf(unit, attr):
+    """Metric-accumulator carry bound to a :class:`memory.Array` attr
+    (``n_err``, ``confusion_matrix``, ``metrics``): undonated, shadowed by
+    a materialize-on-read proxy installed by the runtime, re-seeded from
+    host whenever the host writes (a Decision's per-class reset)."""
+
+    def init():
+        return getattr(unit, attr).devmem  # uploads, clears host-dirty
+
+    def dirty():
+        return getattr(unit, attr)._host_dirty_
+
+    return StateLeaf(attr, (id(unit), attr), init, dirty=dirty,
+                     donate=False, array=(unit, attr))

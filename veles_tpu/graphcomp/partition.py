@@ -1,0 +1,267 @@
+"""Graph introspection + region partition over a workflow's unit DAG.
+
+Walks the ``link_from`` control DAG and the ``link_attrs`` data links of an
+initialized workflow, asks every unit for its :mod:`trace face <.faces>`,
+and partitions the traceable units into maximal regions — weakly-connected
+components of the control graph restricted to traceable nodes.  Host-side
+units (loaders, deciders, plotters, snapshotters, plumbing) sit at region
+boundaries with a recorded *fallback reason*: the debugging face behind
+``tools/dump_graph.py`` ("why didn't my unit fuse?") and the
+``veles_graph_fallback_units`` gauge.
+
+The partition is DESCRIPTIVE: at run time the interpreter's own worklist
+order decides what actually batches into one compiled program (gates and
+all — see :mod:`.runtime`), so the region report and the executed programs
+agree by construction rather than by a second scheduler.
+"""
+
+from .faces import NoFace, TraceFace
+
+
+def _default_reason(unit):
+    """Reason a unit without a face stays host-side, by family."""
+    from ..loader.base import Loader
+    from ..plumbing import StartPoint, EndPoint, Repeater, FireStarter
+    if isinstance(unit, Loader):
+        return ("host-side loader: minibatch serving, shuffling and "
+                "epoch bookkeeping stay on the host")
+    if isinstance(unit, (StartPoint, EndPoint, Repeater, FireStarter)):
+        return "control plumbing (no data math)"
+    try:
+        from ..znicz.decision import DecisionBase
+        if isinstance(unit, DecisionBase):
+            return ("host-side control: epoch decisions, early stopping "
+                    "and metric resets")
+    except Exception:  # noqa: BLE001 — znicz optional in odd builds
+        pass
+    try:
+        from ..snapshotter import SnapshotterBase
+        if isinstance(unit, SnapshotterBase):
+            return "host-side snapshot I/O"
+    except Exception:  # noqa: BLE001
+        pass
+    return "no pure trace face (host-side unit)"
+
+
+def _is_snapshotter(unit):
+    try:
+        from ..snapshotter import SnapshotterBase
+        return isinstance(unit, SnapshotterBase)
+    except Exception:  # noqa: BLE001
+        return False
+
+
+class UnitInfo:
+    __slots__ = ("unit", "face", "reason", "region")
+
+    def __init__(self, unit, face, reason=None, region=None):
+        self.unit = unit
+        self.face = face          # TraceFace | None
+        self.reason = reason      # fallback reason when face is None/opaque
+        self.region = region      # region index | None
+
+    @property
+    def traceable(self):
+        return self.face is not None and not self.face.opaque
+
+    @property
+    def opaque(self):
+        return self.face is not None and self.face.opaque
+
+
+class Region:
+    __slots__ = ("index", "units", "kind")
+
+    def __init__(self, index, units, kind):
+        self.index = index
+        self.units = units        # dependency order
+        self.kind = kind          # "traced" | "precompiled"
+
+
+class GraphPlan:
+    """The analysis result: per-unit faces + reasons, regions, data edges,
+    and the flush-trigger sets the runtime installs."""
+
+    def __init__(self, workflow):
+        self.workflow = workflow
+        self.infos = []           # UnitInfo, dependency order
+        self.by_id = {}           # id(unit) -> UnitInfo
+        self.regions = []
+        self.data_edges = []      # (dst_unit, dst_attr, src_unit, src_attr)
+        #: non-members that overwrite attrs members read as inputs
+        #: (the loader): flush BEFORE they run
+        self.source_triggers = set()     # id(unit)
+        #: non-members that link-read member outputs: flush before they run
+        self.reader_triggers = set()     # id(unit)
+        #: non-members that link-read boundary-synced attrs (weights):
+        #: flush + full state sync before they run
+        self.sync_triggers = set()       # id(unit)
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def analyze(cls, workflow):
+        from ..workflow import Workflow
+        plan = cls(workflow)
+        order = [u for u in workflow._dependency_order()
+                 if u is not workflow and not isinstance(u, Workflow)]
+        for unit in order:
+            face, reason = None, None
+            maker = getattr(unit, "make_trace", None)
+            made = None
+            if not unit.links_from and not unit.links_to:
+                # outside the control graph entirely (fused-mode
+                # forwards/GDs are driven by the step unit, not fired)
+                made = NoFace("outside the control graph (driven by "
+                              "another unit)")
+            elif callable(maker):
+                try:
+                    made = maker()
+                except Exception as exc:  # noqa: BLE001 — a broken face
+                    # must degrade to interpreted dispatch, never error
+                    made = NoFace("make_trace failed: %s: %s"
+                                  % (type(exc).__name__, exc))
+            if isinstance(made, TraceFace):
+                face = made
+                if made.opaque:
+                    reason = made.label
+            elif isinstance(made, NoFace):
+                reason = made.reason
+            else:
+                reason = _default_reason(unit)
+            plan.infos.append(UnitInfo(unit, face, reason))
+        plan.by_id = {id(i.unit): i for i in plan.infos}
+        plan._build_regions()
+        plan._build_data_edges()
+        plan._build_triggers()
+        return plan
+
+    def _build_regions(self):
+        """Weakly-connected components of traceable units over control
+        links; opaque (pre-compiled) units are singleton regions."""
+        traceable = [i for i in self.infos if i.traceable]
+        index = {id(i.unit): n for n, i in enumerate(traceable)}
+        parent = list(range(len(traceable)))
+
+        def find(a):
+            while parent[a] != a:
+                parent[a] = parent[parent[a]]
+                a = parent[a]
+            return a
+
+        def union(a, b):
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[rb] = ra
+
+        for n, info in enumerate(traceable):
+            for dst in info.unit.links_to:
+                m = index.get(id(dst))
+                if m is not None:
+                    union(n, m)
+        groups = {}
+        for n, info in enumerate(traceable):
+            groups.setdefault(find(n), []).append(info)
+        for members in groups.values():  # insertion = dependency order
+            region = Region(len(self.regions),
+                            [i.unit for i in members], "traced")
+            self.regions.append(region)
+            for i in members:
+                i.region = region.index
+        for info in self.infos:
+            if info.opaque:
+                region = Region(len(self.regions), [info.unit],
+                                "precompiled")
+                self.regions.append(region)
+                info.region = region.index
+
+    def _build_data_edges(self):
+        for info in self.infos:
+            unit = info.unit
+            links = unit.__dict__.get("_linked_attrs") or {}
+            for name in links:
+                src, sname = unit.resolve_linked(name)
+                self.data_edges.append((unit, name, src, sname))
+
+    def _build_triggers(self):
+        members = {id(i.unit) for i in self.infos if i.traceable}
+        outputs = {}
+        sync_attrs = {}
+        for info in self.infos:
+            if not info.traceable:
+                continue
+            for o in info.face.outputs:
+                outputs[(id(info.unit), o)] = True
+            for a in info.face.sync_attrs:
+                sync_attrs[(id(info.unit), a)] = True
+        # (a) sources: terminals of member inputs owned by non-members
+        for info in self.infos:
+            if not info.traceable:
+                continue
+            for name in info.face.inputs + info.face.statics:
+                owner, attr = info.unit.resolve_linked(name)
+                if id(owner) not in members and owner is not self.workflow:
+                    self.source_triggers.add(id(owner))
+        # (b)/(c) readers of member outputs / synced attrs
+        for dst, _name, src, sattr in self.data_edges:
+            if id(dst) in members:
+                continue
+            if (id(src), sattr) in outputs:
+                self.reader_triggers.add(id(dst))
+            if (id(src), sattr) in sync_attrs:
+                self.sync_triggers.add(id(dst))
+        # snapshotters deepcopy everything: full sync before they run
+        for info in self.infos:
+            if not info.traceable and _is_snapshotter(info.unit):
+                self.sync_triggers.add(id(info.unit))
+
+    # -- reporting -----------------------------------------------------------
+    @property
+    def traced_unit_count(self):
+        return sum(1 for i in self.infos if i.traceable)
+
+    @property
+    def fallback_units(self):
+        return [(i.unit, i.reason) for i in self.infos
+                if i.face is None]
+
+    def describe(self):
+        """Human-readable DAG + partition report (tools/dump_graph.py)."""
+        wf = self.workflow
+        lines = ["workflow %r: %d units, %d traceable, %d regions"
+                 % (wf.name, len(self.infos), self.traced_unit_count,
+                    len(self.regions))]
+        lines.append("")
+        lines.append("control DAG:")
+        for info in self.infos:
+            dsts = ", ".join(d.name for d in info.unit.links_to) or "-"
+            lines.append("  %-28s -> %s" % (info.unit.name, dsts))
+        lines.append("")
+        lines.append("regions:")
+        if not self.regions:
+            lines.append("  (none — nothing traceable)")
+        for region in self.regions:
+            lines.append("  region %d [%s, %d unit%s]: %s" % (
+                region.index, region.kind, len(region.units),
+                "s" if len(region.units) != 1 else "",
+                ", ".join(u.name for u in region.units)))
+        lines.append("")
+        lines.append("host-side / fallback units:")
+        for unit, reason in self.fallback_units:
+            lines.append("  %-28s %s" % (unit.name, reason))
+        opaques = [i for i in self.infos if i.opaque]
+        if opaques:
+            lines.append("")
+            lines.append("pre-compiled steps (regions of one):")
+            for info in opaques:
+                lines.append("  %-28s %s" % (info.unit.name, info.reason))
+        lines.append("")
+        lines.append("data links (dst.attr <- src.attr):")
+        for dst, name, src, sattr in self.data_edges:
+            lines.append("  %s.%s <- %s.%s"
+                         % (dst.name, name, src.name, sattr))
+        return "\n".join(lines)
+
+
+def analyze(workflow):
+    """Public entry: introspect ``workflow`` into a :class:`GraphPlan`."""
+    return GraphPlan.analyze(workflow)

@@ -1,0 +1,605 @@
+"""GraphCompiler: batch interpreted unit firings into compiled XLA programs.
+
+The interpreter (``Workflow.run``'s worklist + AND-gates) stays the single
+source of truth for control flow — gates, skips, blocks, loops.  This
+controller wraps every traceable unit's ``run()`` to merely RECORD the
+firing; the deferred sequence is *flushed* — composed face-by-face into ONE
+jitted, buffer-donating program — exactly when a host-side unit needs the
+results:
+
+- before a non-member that overwrites member inputs runs (the loader
+  starting the next minibatch);
+- before a non-member that link-reads member outputs runs (plotters);
+- when anyone touches a metric accumulator Array (Decision reading
+  ``n_err`` at a class boundary — the Array is shadowed by a
+  materialize-on-read proxy, so a Decision's early-return steps cost no
+  sync at all);
+- at workflow-run exit and before snapshot capture (full state sync).
+
+Because the recorded sequence already reflects every gate decision the
+interpreter made, gate semantics are free: a ``gate_skip``'d unit was never
+recorded; a flipped gate simply keys a different compiled variant.  Any
+failure to compose or execute permanently falls back to the units' original
+``run()`` methods — interpreted dispatch, never an error.
+
+Programs compile through the persistent executable cache
+(:mod:`veles_tpu.compilecache`) when one is configured: warm restarts
+deserialize every variant (zero XLA compiles) and each variant lands in the
+warmup manifest like every other executable.
+"""
+
+import hashlib
+import logging
+import time
+
+from ..logger import events
+from ..memory import Array
+from ..observability.registry import REGISTRY
+from .partition import analyze
+
+log = logging.getLogger("veles_tpu.graphcomp")
+
+#: hard cap on units batched into one program (a runaway inner loop of
+#: traceable units flushes in segments instead of unrolling unboundedly)
+MAX_SEGMENT = 64
+
+
+def _transient(fn):
+    """Mark a wrapper transient so ``Pickleable.__getstate__`` (and the
+    snapshotter's deepcopy capture) drops it — profiler/prefetcher idiom."""
+    fn.transient_ = True
+    return fn
+
+
+class TracedStateArray(Array):
+    """Stand-in for a metric Array whose live value rides a traced region's
+    carry.  Any host access first *materializes*: flushes pending units and
+    installs the current device value.  Unpickled copies (a snapshot taken
+    while tracing was attached) have no callback and behave as plain
+    Arrays."""
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self._materialize_ = None
+
+    def _pull(self):
+        cb = getattr(self, "_materialize_", None)
+        if cb is not None:
+            cb()
+
+    def map_read(self):
+        self._pull()
+        return super().map_read()
+
+    def map_write(self):
+        self._pull()
+        mem = super().map_write()
+        if mem is not None and not mem.flags.writeable:
+            # the materialized pull is a read-only view of the device
+            # buffer; a host WRITE (Decision resetting an accumulator)
+            # needs its own mutable copy
+            import numpy
+            self._mem = mem = numpy.array(mem)
+        return mem
+
+    def map_invalidate(self):
+        self._pull()
+        return super().map_invalidate()
+
+    def __getstate__(self):
+        self._pull()
+        return super().__getstate__()
+
+
+class _Variant:
+    """One compiled program for one recorded firing sequence."""
+
+    __slots__ = ("key", "name", "call", "aot", "donated", "kept",
+                 "ext_specs", "writebacks", "n_units", "counted")
+
+    def __init__(self, key, name, call, aot, donated, kept, ext_specs,
+                 writebacks, n_units):
+        self.key = key
+        self.name = name
+        self.call = call            # fn(donated_list, kept_list, ext_list)
+        self.aot = aot              # AotStep | None
+        self.donated = donated      # StateLeaf list
+        self.kept = kept            # StateLeaf list
+        self.ext_specs = ext_specs  # ((owner, attr, static_flag), ...)
+        self.writebacks = writebacks  # ((unit, attr), ...)
+        self.n_units = n_units
+        self.counted = False        # fresh-compile counted once
+
+
+def _harden(v):
+    """Python scalars → fixed-width device scalars (AotStep convention)."""
+    import numpy
+    if isinstance(v, (bool, numpy.bool_)):
+        return numpy.bool_(v)
+    if isinstance(v, (int, numpy.integer)):
+        return numpy.int32(v)
+    if isinstance(v, (float, numpy.floating)):
+        return numpy.float32(v)
+    return v
+
+
+class GraphCompiler:
+    """Attach-time controller for one workflow (see module docstring)."""
+
+    loss = None  # StepProfiler fence-probe parity with fused steps
+
+    def __init__(self, workflow, cache="auto", registry=None,
+                 max_segment=MAX_SEGMENT):
+        self.workflow = workflow
+        self.plan = analyze(workflow)
+        self.max_segment = int(max_segment)
+        self._pending = []        # units recorded this window
+        self._window_ext = []     # their external input values, captured
+        #                           AT RECORD TIME (a host unit may
+        #                           mutate a member attr before flush)
+        self._window_ext_index = {}   # (id(owner), attr) -> position
+        self._window_produced = set()  # (id(unit), attr) seen so far
+        self._window_statics = []
+        self._state = {}          # leaf.key -> device pytree
+        self._leaves = {}         # leaf.key -> StateLeaf (first claim)
+        self._variants = {}
+        self._key_skeletons = {}  # ids tuple -> (names, configs)
+        self._unit_spec = {}      # id(unit) -> resolved face spec
+        self._orig_runs = {}      # id(unit) -> original bound run
+        self._proxies = {}        # (id(unit), attr) -> (unit, original)
+        self._wrapped = []        # (obj, wrapper) for detach
+        self._disabled = False
+        self._syncing = False
+        self.flushes = 0
+        self.compiles = 0         # fresh XLA compiles (cache misses)
+        self.cache_hits = 0
+        if cache == "auto":
+            from ..compilecache import default_cache
+            cache = default_cache()
+        self.cache = cache
+        reg = registry or REGISTRY
+        lbl = {"workflow": workflow.name}
+        reg.gauge("veles_graph_regions",
+                  "Traced regions in the compiled workflow graph",
+                  ("workflow",)).labels(**lbl).set(len(self.plan.regions))
+        reg.gauge("veles_graph_fallback_units",
+                  "Units falling back to interpreted dispatch",
+                  ("workflow",)).labels(**lbl).set(
+            len(self.plan.fallback_units))
+        self._c_flushes = reg.counter(
+            "veles_graph_flushes_total",
+            "Traced-region programs dispatched", ("workflow",)).labels(**lbl)
+        if self.plan.traced_unit_count:
+            self._install()
+
+    # -- attach / detach -----------------------------------------------------
+    @classmethod
+    def attach(cls, workflow, **kwargs):
+        """Build + install a controller, or return None when tracing is
+        unsupported here (no jax, numpy backend) — never an error."""
+        try:
+            import jax  # noqa: F401
+        except Exception:  # noqa: BLE001
+            return None
+        from ..backends import NumpyDevice
+        from ..config import root
+        device = getattr(workflow, "device", None)
+        if device is None or isinstance(device, NumpyDevice) or \
+                not getattr(device, "exists", False) or \
+                bool(root.common.engine.get("force_numpy", False)):
+            return None
+        prior = getattr(workflow, "graph_controller_", None)
+        if prior is not None:
+            prior.detach()
+        return cls(workflow, **kwargs)
+
+    def _install(self):
+        for info in self.plan.infos:
+            unit = info.unit
+            if info.traceable:
+                self._orig_runs[id(unit)] = unit.run
+                unit.run = _transient(self._member_wrapper(unit))
+                self._wrapped.append((unit, unit.run))
+                for leaf in info.face.state:
+                    if leaf.key not in self._leaves:
+                        self._leaves[leaf.key] = leaf
+                        if leaf.array is not None:
+                            self._install_proxy(leaf)
+            elif not info.opaque:
+                uid = id(unit)
+                sync = uid in self.plan.sync_triggers
+                if sync or uid in self.plan.source_triggers or \
+                        uid in self.plan.reader_triggers:
+                    orig = unit.run
+                    self._orig_runs[uid] = orig
+                    unit.run = _transient(
+                        self._trigger_wrapper(orig, sync))
+                    self._wrapped.append((unit, unit.run))
+        wf = self.workflow
+        orig_wf_run = wf.run
+        controller = self
+
+        @_transient
+        def wf_run(*args, **kwargs):
+            try:
+                return orig_wf_run(*args, **kwargs)
+            finally:
+                controller.finish()
+        self._orig_wf_run = orig_wf_run
+        wf.run = wf_run
+        self._wrapped.append((wf, wf_run))
+
+    def _install_proxy(self, leaf):
+        unit, attr = leaf.array
+        orig = getattr(unit, attr)
+        if not isinstance(orig, Array) or isinstance(orig,
+                                                     TracedStateArray):
+            return
+        proxy = TracedStateArray()
+        proxy._mem = orig.map_read()
+        proxy._host_dirty_ = True
+        key = leaf.key
+
+        def materialize():
+            self._materialize(key)
+        proxy._materialize_ = materialize
+        setattr(unit, attr, proxy)
+        self._proxies[(id(unit), attr)] = (unit, attr, orig)
+
+    def detach(self):
+        """Flush, sync state back, restore every wrapper and proxy."""
+        self.finish()
+        for obj, wrapper in reversed(self._wrapped):
+            if obj.__dict__.get("run") is wrapper:
+                del obj.__dict__["run"]
+                orig = self._orig_runs.get(id(obj),
+                                           getattr(self, "_orig_wf_run",
+                                                   None)
+                                           if obj is self.workflow else
+                                           None)
+                if orig is not None and \
+                        getattr(orig, "__func__", None) is not \
+                        type(obj).run:
+                    obj.__dict__["run"] = orig
+        self._wrapped = []
+        import numpy
+        for (uid, attr), (unit, aname, orig) in self._proxies.items():
+            proxy = getattr(unit, aname, None)
+            if isinstance(proxy, TracedStateArray):
+                proxy._materialize_ = None
+                # numpy.array: a WRITABLE host copy (a materialized pull
+                # is a read-only device view)
+                orig.mem = numpy.array(proxy.map_read())
+                setattr(unit, aname, orig)
+        self._proxies = {}
+        if getattr(self.workflow, "graph_controller_", None) is self:
+            self.workflow.graph_controller_ = None
+
+    # -- wrappers ------------------------------------------------------------
+    def _spec(self, unit):
+        """Memoized face wiring: resolved inputs/statics (links are
+        static after attach)."""
+        spec = self._unit_spec.get(id(unit))
+        if spec is None:
+            face = self.plan.by_id[id(unit)].face
+            spec = (unit.name, face,
+                    tuple((n,) + unit.resolve_linked(n)
+                          for n in face.inputs),
+                    tuple(unit.resolve_linked(s) for s in face.statics),
+                    face.config())
+            self._unit_spec[id(unit)] = spec
+        return spec
+
+    def _member_wrapper(self, unit):
+        orig = self._orig_runs[id(unit)]
+        # resolve the face wiring ONCE (links are static after attach):
+        # the record path below runs for every member every step
+        _name, face, inputs, statics, _cfg = self._spec(unit)
+        input_keys = tuple(((id(owner), attr), owner, attr)
+                           for _i, owner, attr in inputs)
+        output_keys = tuple((id(unit), o) for o in face.outputs)
+        fetch = self._fetch
+        static_value = self._static_value
+
+        def record():
+            if self._disabled:
+                return orig()
+            # capture external inputs NOW — the values this unit would
+            # have consumed had it run here (a host unit may overwrite
+            # a member attr before the window flushes)
+            produced = self._window_produced
+            ext_index = self._window_ext_index
+            ext = self._window_ext
+            for k, owner, attr in input_keys:
+                if k not in produced and k not in ext_index:
+                    ext_index[k] = len(ext)
+                    ext.append(fetch(owner, attr))
+            for owner, attr in statics:
+                self._window_statics.append(static_value(owner, attr))
+            produced.update(output_keys)
+            self._pending.append(unit)
+            if len(self._pending) >= self.max_segment:
+                self.run()
+        return record
+
+    def _trigger_wrapper(self, orig, sync):
+        def trigger():
+            if self._pending:
+                self.run()
+            if sync:
+                self.sync_state()
+            return orig()
+        return trigger
+
+    # -- the flush -----------------------------------------------------------
+    def run(self):
+        """Flush the recorded firing sequence through ONE compiled program
+        (the traced-region 'step'; StepProfiler wraps this)."""
+        pending = self._pending
+        if not pending:
+            return
+        ext = self._window_ext
+        statics = tuple(self._window_statics)
+        self._pending = []
+        self._window_ext = []
+        self._window_ext_index = {}
+        self._window_produced = set()
+        self._window_statics = []
+        t0 = time.perf_counter()
+        try:
+            ids = tuple(map(id, pending))
+            skeleton = self._key_skeletons.get(ids)
+            if skeleton is None:
+                names, configs = [], []
+                for u in pending:
+                    spec = self._spec(u)
+                    names.append(spec[0])
+                    if spec[4] is not None:
+                        configs.append((spec[0], spec[4]))
+                skeleton = (tuple(names), tuple(configs))
+                self._key_skeletons[ids] = skeleton
+            key = (skeleton[0], statics, skeleton[1])
+            variant = self._variants.get(key)
+            if variant is None:
+                variant = self._build_variant(pending, statics, key)
+                self._variants[key] = variant
+            self._execute(variant, ext)
+        except Exception as exc:  # noqa: BLE001 — semantics of
+            # Unit.run_dependent, never an error: permanent fallback
+            self._fallback(pending, exc)
+            return
+        dt = time.perf_counter() - t0
+        self.flushes += 1
+        self._c_flushes.inc()
+        if events.enabled:
+            events.span("graph.flush", dt, workflow=self.workflow.name,
+                        units=variant.n_units, variant=variant.name)
+
+    def _fallback(self, pending, exc):
+        log.warning(
+            "graph tracing for %r disabled (%s: %s); falling back to "
+            "interpreted dispatch", self.workflow.name,
+            type(exc).__name__, str(exc)[:300])
+        self._disabled = True
+        try:
+            self.sync_state()
+        except Exception:  # noqa: BLE001 — best effort before interpret
+            log.exception("graphcomp: state sync during fallback failed")
+        for unit in pending:
+            self._orig_runs[id(unit)]()
+
+    @staticmethod
+    def _static_value(owner, attr):
+        v = getattr(owner, attr, None)
+        if isinstance(v, Array):
+            raise TypeError("static input %s.%s is an Array"
+                            % (owner.name, attr))
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return v
+        return int(v)  # numpy integer scalars and friends
+
+    def _build_variant(self, pending, flat_statics, key):
+        """Compose the faces of one recorded firing sequence into one
+        jitted program.  The internal/external wiring decisions replay
+        the EXACT algorithm the recorder ran, so the recorder's captured
+        ext list indexes this program's ext argument."""
+        import jax
+        donated, kept, seen = [], [], set()
+        produced = {}
+        ext_specs, ext_index = [], {}
+        steps = []
+        cursor = 0
+        for unit in pending:
+            _name, face, inputs, static_specs, _cfg = self._spec(unit)
+            in_map = {}
+            for name, owner, attr in inputs:
+                k = (id(owner), attr)
+                if k in produced:
+                    in_map[name] = ("env", k)
+                else:
+                    if k not in ext_index:
+                        ext_index[k] = len(ext_specs)
+                        ext_specs.append((owner, attr))
+                    in_map[name] = ("ext", ext_index[k])
+            statics = dict(zip(face.statics,
+                               flat_statics[cursor:cursor +
+                                            len(static_specs)]))
+            cursor += len(static_specs)
+            st_map = {}
+            for leaf in face.state:
+                claimed = self._leaves.setdefault(leaf.key, leaf)
+                if leaf.key not in seen:
+                    seen.add(leaf.key)
+                    (donated if claimed.donate else kept).append(claimed)
+                st_map[leaf.name] = leaf.key
+            for o in face.outputs:
+                produced[(id(unit), o)] = True
+            steps.append((face, in_map, st_map, statics))
+        # EVERY fired unit's outputs write back (lazily, as devmem):
+        # after a flush, member attrs read exactly as interpreted
+        # dispatch would have left them — for link-readers, for
+        # cross-segment wiring, and for anyone inspecting Arrays
+        # after the run
+        writebacks = tuple(
+            (self.plan.by_id[uid].unit, attr)
+            for (uid, attr) in sorted(
+                produced,
+                key=lambda k: (self.plan.by_id[k[0]].unit.name, k[1])))
+        wb_ids = [(id(u), a) for u, a in writebacks]
+        donated_keys = [lf.key for lf in donated]
+        kept_keys = [lf.key for lf in kept]
+
+        def program(donated_vals, kept_vals, ext_vals):
+            state = dict(zip(donated_keys, donated_vals))
+            state.update(zip(kept_keys, kept_vals))
+            env = {}
+            for face, in_map, st_map, statics in steps:
+                ins = {}
+                for name, (tag, ref) in in_map.items():
+                    ins[name] = env[ref] if tag == "env" else ext_vals[ref]
+                st_in = {ln: state[k] for ln, k in st_map.items()}
+                updates, outs = face.fn(st_in, ins, statics)
+                for ln, v in updates.items():
+                    state[st_map[ln]] = v
+                for o, v in outs.items():
+                    env[(id(face.unit), o)] = v
+            return ([state[k] for k in donated_keys],
+                    [state[k] for k in kept_keys],
+                    [env[k] for k in wb_ids])
+
+        jitted = jax.jit(program, donate_argnums=(0,))
+        digest = hashlib.sha256(repr(key).encode()).hexdigest()[:12]
+        name = "graph.%s.%s" % (self.workflow.name, digest)
+        aot = None
+        call = jitted
+        if self.cache is not None:
+            from ..compilecache import AotStep
+            aot = AotStep(jitted, self.cache, name)
+            call = aot
+            # manifest buckets are integers: the variant digest, so a
+            # traced workflow's history reads like any other model's
+            self.cache.manifest.record("graph:%s" % self.workflow.name,
+                                       int(digest, 16))
+        return _Variant(key, name, call, aot, donated, kept,
+                        tuple(ext_specs), writebacks, len(pending))
+
+    def _leaf_value(self, leaf):
+        v = self._state.get(leaf.key)
+        if v is None or leaf.dirty():
+            v = leaf.init()
+            self._state[leaf.key] = v
+        return v
+
+    def _fetch(self, owner, attr):
+        v = getattr(owner, attr)
+        if isinstance(v, Array):
+            return v.devmem
+        return _harden(v)
+
+    def _execute(self, variant, ext):
+        donated = [self._leaf_value(lf) for lf in variant.donated]
+        kept = [self._leaf_value(lf) for lf in variant.kept]
+        d_out, k_out, wb = variant.call(donated, kept, ext)
+        for lf, v in zip(variant.donated, d_out):
+            self._state[lf.key] = v
+        for lf, v in zip(variant.kept, k_out):
+            self._state[lf.key] = v
+        for (unit, attr), v in zip(variant.writebacks, wb):
+            target = getattr(unit, attr, None)
+            if isinstance(target, Array):
+                target.swap_devmem(v)
+            else:
+                setattr(unit, attr, v)
+        if not variant.counted:
+            variant.counted = True
+            if variant.aot is not None and variant.aot.cache_hit:
+                self.cache_hits += 1
+            else:
+                self.compiles += 1
+
+    # -- materialization / sync ----------------------------------------------
+    def _materialize(self, key):
+        if self._syncing:
+            return
+        if self._pending:
+            self.run()
+        value = self._state.get(key)
+        leaf = self._leaves.get(key)
+        if value is None or leaf is None or leaf.array is None:
+            return
+        unit, attr = leaf.array
+        arr = getattr(unit, attr)
+        if not arr._host_dirty_:  # host writes stay authoritative
+            arr.devmem = value
+
+    def sync_state(self):
+        """Flush pending work and write every carry back into its owning
+        unit (params/solver copies, metric devmems) — run-exit, snapshot
+        capture, and detach all come through here."""
+        if self._syncing:
+            return
+        self._syncing = True
+        try:
+            if self._pending:
+                self._syncing = False
+                self.run()
+                self._syncing = True
+            for key, leaf in self._leaves.items():
+                value = self._state.get(key)
+                if value is None:
+                    continue
+                if leaf.sync is not None:
+                    leaf.sync(value)
+                elif leaf.array is not None:
+                    unit, attr = leaf.array
+                    arr = getattr(unit, attr)
+                    if not arr._host_dirty_:
+                        arr.devmem = value
+        finally:
+            self._syncing = False
+
+    def finish(self):
+        if self._pending:
+            self.run()
+        self.sync_state()
+
+    # -- observability surfaces ----------------------------------------------
+    @property
+    def traced_unit_count(self):
+        return self.plan.traced_unit_count
+
+    @property
+    def _params_(self):
+        """StepProfiler fence probe: everything the last flush produced."""
+        return list(self._state.values())
+
+    def profiled_jits(self):
+        """StepProfiler recompile accounting hook."""
+        return [self]
+
+    def _cache_size(self):
+        """Fresh XLA compiles observed so far (StepProfiler recompile
+        accounting): an AOT-cached variant that deserialized counts 0;
+        a freshly-compiled one counts once; plain-jit variants report
+        their own jit cache size (1 per compile, 0 extra later)."""
+        total = 0
+        for variant in self._variants.values():
+            if variant.aot is not None and variant.counted and \
+                    not variant.aot.cache_hit:
+                total += 1
+            fn = getattr(variant.call, "_cache_size", None)
+            try:
+                total += int(fn()) if callable(fn) else 0
+            except Exception:  # noqa: BLE001 — diagnostics never raise
+                pass
+        return total
+
+    def stats(self):
+        return {"regions": len(self.plan.regions),
+                "traced_units": self.plan.traced_unit_count,
+                "fallback_units": len(self.plan.fallback_units),
+                "variants": len(self._variants),
+                "flushes": self.flushes,
+                "compiles": self.compiles,
+                "cache_hits": self.cache_hits,
+                "disabled": self._disabled}

@@ -44,6 +44,21 @@ class InputJoiner(Unit):
             flat = [x.reshape(x.shape[0], -1) for x in inputs]
             return jnp.concatenate(flat, axis=-1)
         self._join_ = join
+        # preallocate output so downstream units can size themselves at
+        # initialize (the ForwardBase convention): rows from the first
+        # input, width = sum of flattened feature widths
+        shapes = []
+        for i in range(self.num_inputs):
+            v = getattr(self, "input_%d" % i)
+            shape = v.shape if isinstance(v, Array) else numpy.shape(v)
+            if not shape:
+                shapes = None
+                break
+            shapes.append(shape)
+        if shapes and not self.output:
+            width = sum(int(numpy.prod(s[1:])) for s in shapes)
+            self.output.reset(numpy.zeros((shapes[0][0], width),
+                                          numpy.float32))
 
     def _value(self, i):
         v = getattr(self, "input_%d" % i)
@@ -56,3 +71,21 @@ class InputJoiner(Unit):
         else:
             flat = [numpy.asarray(x).reshape(len(x), -1) for x in inputs]
             self.output.mem = numpy.concatenate(flat, axis=-1)
+
+    def make_trace(self):
+        """Join face: the same reshape+concatenate the jitted ``_join_``
+        runs, composed into the surrounding region (XLA fuses it with
+        both producers and the consumer)."""
+        from .graphcomp.faces import NoFace, TraceFace
+        if not self.num_inputs:
+            return NoFace("no inputs linked")
+        if self.device is None or not self.device.exists:
+            return NoFace("numpy backend (no jitted path)")
+        names = tuple("input_%d" % i for i in range(self.num_inputs))
+
+        def fn(state_in, inputs, statics):
+            import jax.numpy as jnp
+            flat = [inputs[n].reshape(inputs[n].shape[0], -1)
+                    for n in names]
+            return {}, {"output": jnp.concatenate(flat, axis=-1)}
+        return TraceFace(self, fn, inputs=names, outputs=("output",))

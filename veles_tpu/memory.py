@@ -182,6 +182,24 @@ class Array(Pickleable):
             self._device_dirty_ = True
             self._host_dirty_ = False
 
+    def swap_devmem(self, value):
+        """Hot-path twin of the ``devmem`` setter (the graph compiler
+        writes every traced output back each step): one combined
+        accounting update under a single Watcher lock instead of
+        release+add."""
+        try:
+            nbytes = value.nbytes
+        except Exception:  # noqa: BLE001
+            nbytes = 0
+        with Watcher._lock:
+            Watcher.bytes_in_use += nbytes - self._accounted_
+            if Watcher.bytes_in_use > Watcher.peak_bytes:
+                Watcher.peak_bytes = Watcher.bytes_in_use
+        self._accounted_ = nbytes
+        self._devmem_ = value
+        self._device_dirty_ = True
+        self._host_dirty_ = False
+
     def set_sharding(self, sharding):
         """Future uploads place the value with this jax.sharding.Sharding."""
         self._sharding_ = sharding

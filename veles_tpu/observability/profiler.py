@@ -49,6 +49,12 @@ def _find_step(workflow):
     step = getattr(workflow, "fused_step", None)
     if step is not None:
         return step
+    controller = getattr(workflow, "graph_controller_", None)
+    if controller is not None and controller.traced_unit_count:
+        # whole-workflow compilation: the traced-region flush IS the
+        # step — wrap it so recompiles and host/device phase slices
+        # report exactly like the fused path
+        return controller
     for unit in workflow:
         if getattr(unit, "view_group", None) == "TRAINER":
             return unit
@@ -164,7 +170,13 @@ class StepProfiler:
     # -- instrumentation -----------------------------------------------------
     def _discover_jits(self):
         """Every jitted callable the step owns (``_train_step_``,
-        ``_eval_step_g_``, ...) — anything exposing ``_cache_size``."""
+        ``_eval_step_g_``, ...) — anything exposing ``_cache_size``.  A
+        graph-compiler step publishes its own accounting via
+        ``profiled_jits`` (one aggregate counting variant builds plus any
+        inner-jit retraces)."""
+        hook = getattr(self.step, "profiled_jits", None)
+        if callable(hook):
+            return list(hook())
         jits = []
         for value in vars(self.step).values():
             if callable(getattr(value, "_cache_size", None)):

@@ -41,6 +41,17 @@ class DistributedTrainStep(FusedTrainStep):
             state["mesh"] = mesh_mod.mesh_spec(mesh)
         return state
 
+    def make_trace(self):
+        """Sharding survives tracing by construction: the SPMD step stays
+        a natively-executed pre-compiled region, its in-program sharding
+        annotations (and the ICI all-reduce XLA derives from them)
+        untouched by the graph compiler."""
+        from ..graphcomp.faces import OpaqueFace
+        return OpaqueFace(self, "sharded fused step: one SPMD program "
+                                "over the %r mesh axes"
+                                % list(getattr(self.mesh, "axis_names",
+                                               ())))
+
     def initialize(self, device=None, **kwargs):
         if isinstance(self.mesh, dict):   # restored from a snapshot
             self.mesh = mesh_mod.make_mesh(self.mesh)

@@ -265,6 +265,37 @@ class Unit(Lockable, IDistributable, metaclass=UnitRegistry):
             dst.signal(self, schedule)
 
     # -- introspection -------------------------------------------------------
+    def resolve_linked(self, name):
+        """Terminal ``(owner, attr)`` of a possibly-chained linked
+        attribute: follows ``link_attrs`` pointers (gd.err_output →
+        next_gd.err_input → ...) to the unit that actually owns the
+        storage — the graph compiler's data-edge resolution, matching
+        what ``__getattribute__`` does dynamically."""
+        unit, attr, seen = self, name, set()
+        while True:
+            links = unit.__dict__.get("_linked_attrs") or {}
+            if attr in links and (id(unit), attr) not in seen:
+                seen.add((id(unit), attr))
+                src, sname, _ = links[attr]
+                unit, attr = src, sname
+            else:
+                return unit, attr
+
+    def data_links(self):
+        """{my_attr: (owner_unit, owner_attr)} for every linked attribute
+        (resolved to its terminal owner)."""
+        links = self.__dict__.get("_linked_attrs") or {}
+        return {name: self.resolve_linked(name) for name in links}
+
+    def make_trace(self):
+        """The unit's pure per-step face for whole-workflow compilation
+        (:mod:`veles_tpu.graphcomp`): return a
+        :class:`~veles_tpu.graphcomp.faces.TraceFace` to participate in
+        traced regions, a ``NoFace(reason)`` to document why not, or
+        None (default) for host-side units — the tracer then keeps this
+        unit interpreted and reports a family-derived reason."""
+        return None
+
     def describe(self):
         return {
             "name": self.name,

@@ -287,6 +287,36 @@ class Workflow(Container):
         self.prefetcher_ = MinibatchPrefetcher.attach(loader, **kwargs)
         return self.prefetcher_
 
+    # -- whole-workflow compilation ------------------------------------------
+    def attach_graph_compiler(self, **kwargs):
+        """Trace this workflow's unit DAG into compiled XLA programs
+        (:mod:`veles_tpu.graphcomp`): consecutively-fired units with pure
+        trace faces batch into ONE jitted, buffer-donating program per
+        flush; host-side units (loader, decision, plotters) stay
+        interpreted at region boundaries with recorded fallback reasons.
+        Call after ``initialize`` (faces need shapes and params) and
+        BEFORE ``attach_profiler`` (the profiler then wraps the traced
+        flush).  Returns the controller, or None when tracing is
+        unsupported (no jax, numpy backend).  Stored transiently
+        (``graph_controller_``): snapshots never pickle the controller;
+        restored workflows re-attach through their own initialize."""
+        from .graphcomp import GraphCompiler
+        self.graph_controller_ = GraphCompiler.attach(self, **kwargs)
+        return self.graph_controller_
+
+    @property
+    def graph_controller(self):
+        return getattr(self, "graph_controller_", None)
+
+    def __getstate__(self):
+        # a snapshot taken while tracing is attached must capture the
+        # CURRENT carry (weights, solver state, metric accumulators), so
+        # it restores/resumes identically on a process without tracing
+        controller = getattr(self, "graph_controller_", None)
+        if controller is not None:
+            controller.sync_state()
+        return super().__getstate__()
+
     # -- observability -------------------------------------------------------
     def attach_profiler(self, **kwargs):
         """Instrument this workflow's training step with a

@@ -157,6 +157,28 @@ class All2AllSoftmax(All2All):
         self.max_idx.mem = numpy.argmax(
             self.output.map_read(), axis=-1).astype(numpy.int32)
 
+    def make_trace(self):
+        """Softmax head face: the generic forward face plus the
+        ``max_idx`` side output graph-mode computes host-side (same
+        first-max tie rule, so traced == interpreted bit-for-bit)."""
+        from ..graphcomp.faces import (NoFace, TraceFace,
+                                       forward_params_leaf)
+        if not self._initialized:
+            return NoFace("unit not initialized")
+        if getattr(self, "_backend_run_", None) != self.tpu_run:
+            return NoFace("numpy backend (no jitted path)")
+
+        def fn(state_in, inputs, statics):
+            import jax.numpy as jnp
+            out = self.apply(state_in["params"], inputs["input"])
+            return {}, {"output": out,
+                        "max_idx": jnp.argmax(out, axis=-1).astype(
+                            jnp.int32)}
+        return TraceFace(self, fn, inputs=("input",),
+                         outputs=("output", "max_idx"),
+                         state=(forward_params_leaf(self),),
+                         sync_attrs=("weights", "bias"))
+
 
 class ResizableAll2All(All2All):
     """All2All whose output width can grow/shrink mid-training, preserving

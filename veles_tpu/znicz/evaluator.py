@@ -98,6 +98,66 @@ class EvaluatorSoftmax(EvaluatorBase):
     def get_metric_values(self):
         return {"n_err": int(self.n_err[0]), "loss": self.loss}
 
+    def make_trace(self):
+        """Pure face of the softmax evaluator: the same masked
+        ``err = y - onehot`` / error-count / confusion arithmetic as
+        :meth:`run`, with the metric accumulators riding the region carry
+        on device (flushed lazily — a Decision's class-boundary read
+        materializes them).  Integer metrics (n_err, confusion) are exact,
+        so traced == interpreted bit-for-bit."""
+        from ..graphcomp.faces import NoFace, TraceFace, array_state_leaf
+        if type(self).run is not EvaluatorSoftmax.run:
+            return NoFace("custom evaluator run")
+        if self.output is None or self.labels is None:
+            return NoFace("evaluator inputs not linked")
+        state = [array_state_leaf(self, "n_err"),
+                 array_state_leaf(self, "max_err_output_sum")]
+        with_cm = self.compute_confusion_matrix and \
+            bool(self.confusion_matrix)
+        if with_cm:
+            state.append(array_state_leaf(self, "confusion_matrix"))
+        inputs = ["output", "labels"]
+        with_max_idx = self.max_idx is not None
+        if with_max_idx:
+            inputs.append("max_idx")
+
+        def fn(state_in, ins, statics):
+            import jax.numpy as jnp
+            y = ins["output"]
+            bs = int(statics["batch_size"])
+            labels = ins["labels"].astype(jnp.int32)
+            n = y.shape[0]
+            mask = jnp.arange(n) < bs
+            valid = labels[:bs]
+            onehot = jnp.zeros_like(y).at[
+                (jnp.arange(bs), valid)].set(1)
+            err = jnp.where(mask[:, None], y - onehot, 0)
+            pred_full = ins["max_idx"] if with_max_idx else \
+                jnp.argmax(y, axis=-1)
+            pred = pred_full[:bs].astype(jnp.int32)
+            wrong = (pred != valid).sum()
+            n_err = state_in["n_err"] + \
+                wrong.astype(state_in["n_err"].dtype)
+            eps = 1e-30
+            probs = jnp.take_along_axis(y[:bs], valid[:, None],
+                                        axis=-1)[:, 0]
+            loss = -jnp.log(probs + eps).mean()
+            row_err = jnp.abs(err[:bs]).sum(axis=1).max()
+            mx = jnp.maximum(state_in["max_err_output_sum"],
+                             row_err.astype(
+                                 state_in["max_err_output_sum"].dtype))
+            updates = {"n_err": n_err, "max_err_output_sum": mx}
+            if with_cm:
+                cm = state_in["confusion_matrix"]
+                updates["confusion_matrix"] = cm.at[(pred, valid)].add(
+                    jnp.ones((), cm.dtype))
+            return updates, {"err_output": err, "loss": loss}
+        return TraceFace(self, fn, inputs=tuple(inputs),
+                         statics=("batch_size",),
+                         outputs=("err_output", "loss"),
+                         state=tuple(state),
+                         config=(with_cm, with_max_idx))
+
     # pure loss for the fused trainer ---------------------------------------
     @staticmethod
     def loss_from_logits(logits, labels, mask):
@@ -144,6 +204,40 @@ class EvaluatorMSE(EvaluatorBase):
         return {"mse_sum": float(self.metrics[0]),
                 "max_mse": float(self.metrics[1]),
                 "min_mse": float(self.metrics[2])}
+
+    def make_trace(self):
+        """Pure face of the MSE evaluator.  ``err_output`` (what the GD
+        chain consumes) is exact; the running ``metrics`` accumulate on
+        device in float32 instead of the host's float64 — weights stay
+        bitwise-identical traced vs interpreted, epoch rmse agrees to
+        float32 precision (documented in COMPONENTS.md)."""
+        from ..graphcomp.faces import NoFace, TraceFace, array_state_leaf
+        if type(self).run is not EvaluatorMSE.run:
+            return NoFace("custom evaluator run")
+        if self.output is None or self.target is None:
+            return NoFace("evaluator inputs not linked")
+
+        def fn(state_in, ins, statics):
+            import jax.numpy as jnp
+            y = ins["output"]
+            t = ins["target"]
+            bs = int(statics["batch_size"])
+            n = y.shape[0]
+            mask = jnp.arange(n) < bs
+            err = (y - t).reshape(n, -1)
+            err = jnp.where(mask[:, None], err, 0)
+            sample_mse = (err[:bs] ** 2).mean(axis=1)
+            rmse = jnp.sqrt(sample_mse)
+            m = state_in["metrics"]
+            m = m.at[0].add(sample_mse.sum().astype(m.dtype))
+            m = m.at[1].max(rmse.max().astype(m.dtype))
+            m = m.at[2].min(rmse.min().astype(m.dtype))
+            return {"metrics": m}, {"err_output": err.reshape(y.shape),
+                                    "mse": rmse}
+        return TraceFace(self, fn, inputs=("output", "target"),
+                         statics=("batch_size",),
+                         outputs=("err_output", "mse"),
+                         state=(array_state_leaf(self, "metrics"),))
 
     @staticmethod
     def loss_from_output(y, target, mask):

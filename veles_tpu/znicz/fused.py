@@ -468,3 +468,14 @@ class FusedTrainStep(Unit, IResultProvider):
     def get_metric_values(self):
         return {"n_err": int(self.n_err[0]),
                 "loss": None if self.loss is None else float(self.loss)}
+
+    def make_trace(self):
+        """The hand-fused step is already ONE compiled, donated program:
+        under whole-workflow compilation it reports as a pre-compiled
+        region of its own (one producer of traced regions, not a special
+        case) and keeps executing natively — including its sharded and
+        epoch-scan subclasses, whose in-program shardings survive
+        untouched."""
+        from ..graphcomp.faces import OpaqueFace
+        return OpaqueFace(self, "hand-fused train step: one compiled "
+                                "donated program per minibatch")

@@ -231,6 +231,32 @@ class ForwardBase(NNUnitBase):
         if self.stochastic:
             self._jitted_train_ = jax.jit(self.apply_train)
 
+    def make_trace(self):
+        """Generic forward face: ``apply(params, x)`` is already the pure
+        function graph-compilation needs; the params ride the region's
+        donated carry (shared, by key, with the GD unit that updates
+        them).  Stochastic forwards draw per-minibatch keys host-side and
+        stay interpreted."""
+        from ..graphcomp.faces import (NoFace, TraceFace,
+                                       forward_params_leaf)
+        if self.stochastic:
+            return NoFace("stochastic forward (host-side per-minibatch "
+                          "key draws)")
+        if type(self).tpu_run is not ForwardBase.tpu_run:
+            return NoFace("custom tpu_run (side effects beyond the pure "
+                          "apply)")
+        if not self._initialized:
+            return NoFace("unit not initialized")
+        if getattr(self, "_backend_run_", None) != self.tpu_run:
+            return NoFace("numpy backend (no jitted path)")
+        state = (forward_params_leaf(self),) if self.params else ()
+
+        def fn(state_in, inputs, statics):
+            return {}, {"output": self.apply(state_in.get("params", {}),
+                                             inputs["input"])}
+        return TraceFace(self, fn, inputs=("input",), outputs=("output",),
+                         state=state, sync_attrs=("weights", "bias"))
+
     def tpu_run(self):
         x = self.input.devmem if isinstance(self.input, Array) else self.input
         if self._graph_training():
@@ -432,19 +458,114 @@ class GradientDescentBase(NNUnitBase):
         import jax
         # n_valid stays static (bounded set of sizes → bounded retraces)
         self._jitted_bwd_ = jax.jit(self.backward, static_argnames="n_valid")
+        # backward + regularizer + solver update as ONE jit: one dispatch
+        # per GD run instead of jit(backward) plus ~6 eager solver ops
+        # per parameter, and — critically — the exact function the graph
+        # compiler composes into whole-workflow programs, so traced and
+        # interpreted dispatch are bitwise-identical by construction.
+        # Learning rates ride as ARGUMENTS (LearningRateAdjuster mutates
+        # them per epoch without retracing); decay/solver hyperparameters
+        # are closed over and fingerprinted by the face's config key.
+        self._jitted_step_ = jax.jit(self._device_step,
+                                     static_argnames="n_valid")
+
+    def _device_step(self, params, solver_state, x, y, err_output, lr,
+                     lr_bias, n_valid):
+        """Pure fused backward: (params', solver_state', err_input)."""
+        import jax.numpy as jnp
+        err_in, grads = self.backward(params, x, y, err_output,
+                                      n_valid=n_valid)
+        new_params, new_state = {}, {}
+        for name, p in params.items():
+            g = grads[name]
+            decay, l1l2, ortho = self.decay_for(name)
+            g = solvers.regularized_grad(g, p, decay, l1l2, jnp, ortho)
+            delta, st = self.solver.update(
+                g, p, solver_state[name],
+                lr_bias if name == "bias" else lr, jnp)
+            new_params[name] = p + delta
+            new_state[name] = st
+        return new_params, new_state, err_in
 
     def tpu_run(self):
+        import numpy
         import jax.numpy as jnp
         x = self._dev(self.input)
         y = self._dev(self.output)
         err_out = self._dev(self.err_output)
         params = self._gather_params(host=False)
-        err_in, grads = self._jitted_bwd_(params, x, y, err_out,
-                                          n_valid=self._n_valid(x))
-        new_params = self.apply_updates(params, grads, jnp)
+        if getattr(self, "_jitted_step_", None) is None:
+            # subclasses overriding tpu_init (dropout, stochastic
+            # pooling) keep the classic jit(backward) + eager-update path
+            err_in, grads = self._jitted_bwd_(params, x, y, err_out,
+                                              n_valid=self._n_valid(x))
+            new_params = self.apply_updates(params, grads, jnp)
+        else:
+            self.ensure_solver_state(params, jnp)
+            state = {n: self.solver_state[n] for n in params}
+            new_params, new_state, err_in = self._jitted_step_(
+                params, state, x, y, err_out,
+                numpy.float32(self.learning_rate),
+                numpy.float32(self.learning_rate_bias),
+                n_valid=self._n_valid(x))
+            for n, st in new_state.items():
+                self.solver_state[n] = st
         self._store_params(new_params, host=False)
         if self.need_err_input:
             self.err_input.devmem = err_in
+
+    def make_trace(self):
+        """Generic GD face: composes :meth:`_device_step` — the SAME
+        function the interpreted path jits — into the region program;
+        params are shared (by key) with the linked forward, solver state
+        is this unit's own carry synced back into ``solver_state``."""
+        from ..graphcomp.faces import (NoFace, TraceFace, forward_params_leaf,
+                                       gd_params_leaf, solver_state_leaf)
+        if type(self).tpu_init is not GradientDescentBase.tpu_init:
+            return NoFace("custom backward path (per-minibatch host "
+                          "state)")
+        if type(self).tpu_run is not GradientDescentBase.tpu_run:
+            return NoFace("custom tpu_run")
+        if type(self).apply_updates is not GradientDescentBase.apply_updates:
+            return NoFace("custom update rule")
+        if not self._initialized:
+            return NoFace("unit not initialized")
+        if getattr(self, "_backend_run_", None) != self.tpu_run:
+            return NoFace("numpy backend (no jitted path)")
+        fwd = self.forward_unit
+        state = []
+        if fwd is not None and fwd.params:
+            state.append(forward_params_leaf(fwd))
+        elif fwd is None and self.weights:
+            state.append(gd_params_leaf(self))
+        if state:
+            params_of = (lambda: dict(fwd.params)) if fwd is not None \
+                else (lambda: self._gather_params(host=False))
+            state.append(solver_state_leaf(self, params_of))
+        outputs = ("err_input",) if self.need_err_input else ()
+        config = (self.decay_for("weights"), self.decay_for("bias"),
+                  self.solver_name,
+                  tuple(sorted(self.solver.hyper.items())),
+                  self.need_err_input)
+
+        def fn(state_in, inputs, statics):
+            n_valid = statics["batch_size"]
+            if n_valid is None:
+                n_valid = inputs["input"].shape[0]
+            new_p, new_s, err_in = self._device_step(
+                state_in.get("params", {}), state_in.get("solver", {}),
+                inputs["input"], inputs["output"], inputs["err_output"],
+                inputs["learning_rate"], inputs["learning_rate_bias"],
+                int(n_valid))
+            updates = {"params": new_p, "solver": new_s} if new_p else {}
+            outs = {"err_input": err_in} if self.need_err_input else {}
+            return updates, outs
+        return TraceFace(
+            self, fn,
+            inputs=("input", "output", "err_output", "learning_rate",
+                    "learning_rate_bias"),
+            statics=("batch_size",), outputs=outputs, state=tuple(state),
+            config=config)
 
     @staticmethod
     def _host(v):

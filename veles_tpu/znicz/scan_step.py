@@ -42,6 +42,14 @@ class ScanEpochStep(FusedTrainStep):
         self.link_loader(loader)
         return self
 
+    def make_trace(self):
+        """Epoch-scan composes with traced regions as a pre-compiled
+        region of its own: one ``lax.scan`` dispatch already covers a
+        whole class, so the graph compiler passes it through natively."""
+        from ..graphcomp.faces import OpaqueFace
+        return OpaqueFace(self, "epoch-scan step: one lax.scan dispatch "
+                                "per dataset class")
+
     def initialize(self, device=None, **kwargs):
         if not self.loader.is_initialized:
             # normally the dependency walk has initialized the loader

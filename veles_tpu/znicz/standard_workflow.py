@@ -124,6 +124,9 @@ class StandardWorkflow(Workflow):
             self.layers_config = list(kwargs.get("layers", ()))
         self.loss_function = kwargs.get("loss_function", "softmax")
         self.fused = kwargs.get("fused", True)
+        # whole-workflow compilation (veles_tpu.graphcomp): None =
+        # follow root.common.engine.graph_compile (default off)
+        self.graph_compile = kwargs.get("graph_compile", None)
         self.mesh = kwargs.get("mesh")           # jax.sharding.Mesh → SPMD
         self.model_axis = kwargs.get("model_axis")
         self.tp_mode = kwargs.get("tp_mode", "column")
@@ -364,7 +367,23 @@ class StandardWorkflow(Workflow):
             self._relink_gates()
         result = super().initialize(device=device, **kwargs)
         self._maybe_attach_prefetcher(device)
+        self._maybe_attach_graph_compiler()
         return result
+
+    def _maybe_attach_graph_compiler(self):
+        """Adopt whole-workflow compilation behind the
+        ``root.common.engine.graph_compile`` knob (or the per-workflow
+        ``graph_compile=`` ctor override).  In graph mode the per-unit
+        chain traces into one compiled program per minibatch; in fused/
+        scan/mesh modes the pre-fused step passes through as its own
+        region, so flipping the knob never regresses the blessed path.
+        getattr: snapshots written before the knob existed restore."""
+        from ..config import root
+        enabled = getattr(self, "graph_compile", None)
+        if enabled is None:
+            enabled = root.common.engine.get("graph_compile", False)
+        if enabled:
+            self.attach_graph_compiler()
 
     def _maybe_attach_prefetcher(self, device):
         """Overlap host minibatch prep with device compute on the
