@@ -1037,6 +1037,119 @@ def bench_snapshot(batch=512, steps=8, snaps=5, repeats=4):
     return out
 
 
+def bench_checkpoint(batch=512, steps=8, snaps=4, repeats=3):
+    """Sharded content-addressed checkpoints vs the pickle monolith
+    (ISSUE 10): per-checkpoint training-thread stall (async capture on
+    both paths), full restore wall time, and the dedupe ratio — bytes a
+    re-export of UNCHANGED state writes (shards: zero; pickle: the whole
+    blob, every time).  Same interleaved-window methodology as the
+    snapshot stage, one fresh subprocess."""
+    import shutil
+    import tempfile
+    from veles_tpu import loader as loader_mod
+    from veles_tpu.backends import Device
+    from veles_tpu.checkpoint import SnapshotterToShards
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.snapshotter import SnapshotterToFile, restore
+    from veles_tpu.znicz.samples import mnist as mnist_sample
+
+    _stamp("checkpoint stage: building mnist step loop")
+    wf = mnist_sample.create_workflow(
+        loader={"minibatch_size": batch, "n_train": 8 * batch,
+                "n_valid": batch, "use_fixture": False,
+                "prng": RandomGenerator().seed(3)},
+        decision={"max_epochs": 10 ** 9, "silent": True})
+    wf.initialize(device=Device(backend="auto"))
+    step = wf.fused_step
+
+    def run_steps(n):
+        done = 0
+        while done < n:
+            wf.loader.run()
+            if wf.loader.minibatch_class == loader_mod.TRAIN:
+                step.run()
+                done += 1
+        _sync(step)
+
+    run_steps(steps)  # compile + warmup
+    pickle_dir = tempfile.mkdtemp(prefix="veles-ckpt-bench-p-")
+    shards_dir = tempfile.mkdtemp(prefix="veles-ckpt-bench-s-")
+    pick = SnapshotterToFile(wf, prefix="bench", directory=pickle_dir,
+                             time_interval=0, compression="gz")
+    shrd = SnapshotterToShards(wf, prefix="bench", directory=shards_dir,
+                               time_interval=0)
+
+    def window(snap):
+        stalls = []
+        for _ in range(snaps):
+            run_steps(steps)
+            t0 = time.perf_counter()
+            snap._counter += 1
+            snap.export()
+            stalls.append(time.perf_counter() - t0)
+        snap.flush()               # untimed backlog drain
+        return stalls
+
+    out = {}
+    try:
+        window(shrd)               # warm both paths (capture + writer)
+        window(pick)
+        pickle_t, shards_t = [], []
+        for _ in range(repeats):   # interleaved: contention drift cancels
+            pickle_t += window(pick)
+            shards_t += window(shrd)
+        for snap in (pick, shrd):
+            failure = snap._get_writer().take_failure()
+            if failure is not None:
+                raise failure
+
+        # dedupe: re-export with NOTHING trained in between
+        shrd._counter += 1
+        shrd.export()
+        shrd.flush()
+        trained = dict(shrd._last_write_stats_)
+        shrd._counter += 1
+        shrd.export()
+        shrd.flush()
+        unchanged = dict(shrd._last_write_stats_)
+
+        # restore wall time, whole workflow, newest checkpoint each
+        t0 = time.perf_counter()
+        restore(os.path.join(pickle_dir, "bench_current"))
+        pickle_restore = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        restore(os.path.join(shards_dir, "bench_current"))
+        shards_restore = time.perf_counter() - t0
+
+        med = statistics.median
+        _record("checkpoint_stall_pickle", pickle_t)
+        _record("checkpoint_stall_shards", shards_t)
+        out = {"checkpoint_stall_pickle_ms":
+               round(med(pickle_t) * 1e3, 3),
+               "checkpoint_stall_shards_ms":
+               round(med(shards_t) * 1e3, 3),
+               "checkpoint_restore_pickle_s": round(pickle_restore, 3),
+               "checkpoint_restore_shards_s": round(shards_restore, 3),
+               "checkpoint_tensor_bytes": trained.get("bytes_total"),
+               "checkpoint_unchanged_rewrite_bytes":
+               unchanged.get("bytes_written"),
+               "checkpoint_dedupe_saved_bytes":
+               (unchanged.get("bytes_total", 0) -
+                unchanged.get("bytes_written", 0))}
+    finally:
+        pick.stop()
+        shrd.stop()
+        wf.del_ref(pick)
+        wf.del_ref(shrd)
+        shutil.rmtree(pickle_dir, ignore_errors=True)
+        shutil.rmtree(shards_dir, ignore_errors=True)
+    _stamp("checkpoint stage: measured (unchanged re-export writes %s "
+           "of %s tensor bytes)"
+           % (out.get("checkpoint_unchanged_rewrite_bytes"),
+              out.get("checkpoint_tensor_bytes")))
+    return out
+
+
 def bench_liveness():
     """Stage 0 gate: one tiny jitted matmul with a real D2H flush.  If
     THIS can't finish, the tunnel is down and the orchestrator reports
@@ -1087,6 +1200,8 @@ def _stage_main(stage):
         out = bench_observability()
     elif stage == "snapshot":
         out = bench_snapshot()
+    elif stage == "checkpoint":
+        out = bench_checkpoint()
     elif stage == "cold_start":
         out = bench_cold_start()
     elif stage == "decode":
@@ -1138,6 +1253,10 @@ STAGE_PLAN = [
     # per-snapshot step-loop stall, sync vs async write + the gz9->gz6
     # compression-level delta (ISSUE 4 acceptance: stall >= 5x)
     ("snapshot", 300),
+    # sharded content-addressed checkpoints vs the pickle monolith
+    # (ISSUE 10): save stall, restore wall time, dedupe bytes on an
+    # unchanged re-export (shards must write ~zero) — fresh subprocess
+    ("checkpoint", 420),
     # process-restart cost with the persistent executable cache off /
     # cold / warm (ISSUE 5 acceptance: warm serving warmup >= 2x) —
     # six fresh subprocesses, each its own import+compile, so this

@@ -1,0 +1,313 @@
+"""Sharded tensor checkpoints (ISSUE 10): content-addressed chunk
+store, tensor extraction/restore, SnapshotterToShards end-to-end, the
+generic save_state/load_state pytree API, and decode-KV warm restore.
+
+The acceptance property threaded through everything: a restored
+workflow continues training BITWISE identical to the uninterrupted
+run, and unchanged tensors re-checkpoint with zero new bytes.
+"""
+
+import copy
+import glob
+import os
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.checkpoint import (ChunkStore, CorruptChunkError, Manifest,
+                                  SnapshotterToShards, TensorReader,
+                                  TensorSink, extracting, import_dir,
+                                  list_checkpoints, load_state,
+                                  open_checkpoint, quarantine_partials,
+                                  resolve_checkpoint, restoring, save_state)
+from veles_tpu.checkpoint.tensors import write_tensors
+from veles_tpu.memory import Array
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.snapshotter import restore
+
+from test_snapshot_async import build
+
+
+# -- chunk store --------------------------------------------------------------
+
+def test_chunk_store_roundtrip_and_dedupe(tmp_path):
+    store = ChunkStore(str(tmp_path))
+    data = numpy.arange(1000, dtype=numpy.float64)
+    digest, written = store.put(data)
+    assert written == data.nbytes          # bytes, not first-dim rows
+    assert store.has(digest)
+    again, written2 = store.put(data.copy())
+    assert again == digest and written2 == 0      # content dedupe
+    back = numpy.frombuffer(store.get(digest), numpy.float64)
+    assert numpy.array_equal(back, data)
+
+
+def test_chunk_store_quarantines_corruption(tmp_path):
+    store = ChunkStore(str(tmp_path))
+    digest, _ = store.put(b"payload")
+    with open(store.path_for(digest), "wb") as f:
+        f.write(b"tampered")
+    with pytest.raises(CorruptChunkError):
+        store.get(digest)
+    assert not store.has(digest)
+    assert os.path.exists(store.path_for(digest) + ".corrupt")
+
+
+def test_chunk_store_gc(tmp_path):
+    store = ChunkStore(str(tmp_path))
+    keep, _ = store.put(b"keep me")
+    drop, _ = store.put(b"drop me")
+    removed, freed = store.gc([keep])
+    assert removed == 1 and freed == len(b"drop me")
+    assert store.has(keep) and not store.has(drop)
+
+
+# -- tensor extraction / restore ----------------------------------------------
+
+def test_array_extracts_through_pickle_and_restores(tmp_path):
+    arr = Array()
+    arr.reset(numpy.random.RandomState(0).standard_normal(
+        (64, 32)).astype(numpy.float32))
+    sink = TensorSink(min_bytes=1)
+    with extracting(sink):
+        blob = pickle.dumps(arr)
+    assert sink.tensors, "payload was not diverted"
+    store = ChunkStore(str(tmp_path))
+    entries, stats = write_tensors(store, sink, chunk_bytes=4096)
+    assert stats["bytes_total"] == arr.mem.nbytes
+    reader = TensorReader(store, Manifest(tensors=entries))
+    with restoring(reader):
+        back = pickle.loads(blob)
+    assert numpy.array_equal(back.mem, arr.mem)
+    assert back.mem.dtype == arr.mem.dtype
+
+
+def test_deepcopy_then_pickle_matches_capture_path(tmp_path):
+    """The async-capture shape: deepcopy first (stubs installed via
+    Array.__getstate__), then pickle the twin on another 'thread'."""
+    from veles_tpu.checkpoint.tensors import dumps_extracting
+    arr = Array()
+    arr.reset(numpy.arange(4096, dtype=numpy.float32).reshape(64, 64))
+    sink = TensorSink(min_bytes=1)
+    with extracting(sink):
+        twin = copy.deepcopy(arr)
+    blob = dumps_extracting(twin, sink)       # writer-thread pickle
+    store = ChunkStore(str(tmp_path))
+    entries, _ = write_tensors(store, sink, chunk_bytes=1 << 20)
+    reader = TensorReader(store, Manifest(tensors=entries))
+    with restoring(reader):
+        from veles_tpu.checkpoint.tensors import ResolvingUnpickler
+        import io
+        back = ResolvingUnpickler(io.BytesIO(blob), reader).load()
+    assert numpy.array_equal(back.mem, arr.mem)
+
+
+def test_extraction_keeps_interpreted_state_inline():
+    """Objects whose __setstate__ CONSUMES arrays (numpy RandomState
+    via our prng wrapper) must survive capture deepcopy: plain
+    ndarrays are extracted at pickle time, never at deepcopy time."""
+    gen = RandomGenerator().seed(123)
+    gen.normal(size=10)
+    sink = TensorSink(min_bytes=1)
+    with extracting(sink):
+        twin = copy.deepcopy(gen)             # would raise before fix
+    a = gen.normal(size=5)
+    b = twin.normal(size=5)
+    assert numpy.array_equal(a, b)
+
+
+def test_sharded_jax_array_restore_memory_cap(tmp_path):
+    """Per-shard restore through make_array_from_callback never
+    assembles the full tensor on host: the reader's resolve() cap
+    proxies 'model bigger than host RAM'."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from veles_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    sharding = NamedSharding(mesh, PartitionSpec("data"))
+    big = jax.device_put(
+        numpy.arange(8 * 1024, dtype=numpy.float32).reshape(8, 1024),
+        sharding)
+    sink = TensorSink(min_bytes=1)
+    ref = sink.add(big)
+    store = ChunkStore(str(tmp_path))
+    entries, _ = write_tensors(store, sink, chunk_bytes=2048)
+    reader = TensorReader(store, Manifest(tensors=entries))
+    # host assembly refused above the cap...
+    reader.max_resolve_bytes = big.nbytes // 2
+    with pytest.raises(MemoryError):
+        reader.resolve(ref)
+    # ...but the shard-wise device restore works under the same cap
+    restored = reader.restore_array(ref, sharding)
+    assert numpy.array_equal(numpy.asarray(restored),
+                             numpy.asarray(big))
+    assert restored.sharding == sharding
+
+
+# -- SnapshotterToShards end-to-end -------------------------------------------
+
+def test_shards_snapshot_restore_bitwise_continuation(tmp_path):
+    """THE acceptance property: checkpoint mid-training, restore,
+    continue — weights bitwise equal to the uninterrupted run; the
+    async capture path is exercised (no sync fallback)."""
+    ref = build(6)
+    ref.run()
+    ref_w = [numpy.array(f.weights.map_read()) for f in ref.forwards]
+
+    wf = build(3, tmp_path, snap_kwargs={"format": "shards",
+                                         "min_tensor_bytes": 1})
+    assert isinstance(wf.snapshotter, SnapshotterToShards)
+    assert wf.snapshotter._async_enabled()
+    wf.run()
+    stats = wf.snapshotter._last_write_stats_
+    assert stats["bytes_total"] > 0, "no tensors were extracted"
+
+    current = str(tmp_path / "blob_current")
+    assert os.path.islink(current)
+    resumed = restore(current)
+    assert resumed.restored_from_snapshot
+    resumed.decision.max_epochs = 6
+    resumed.initialize(device=Device(backend="cpu"))
+    resumed.run()
+    res_w = [numpy.array(f.weights.map_read()) for f in resumed.forwards]
+    for a, b in zip(ref_w, res_w):
+        assert a.dtype == b.dtype
+        assert numpy.array_equal(a, b)
+
+
+def test_shards_dedupe_across_checkpoints(tmp_path):
+    """Re-exporting unchanged state writes ZERO new chunk bytes."""
+    wf = build(2, tmp_path, snap_kwargs={"format": "shards",
+                                         "min_tensor_bytes": 1,
+                                         "chunk_bytes": 4096})
+    wf.run()
+    snap = wf.snapshotter
+    snap._counter += 1
+    snap.export()
+    snap._get_writer().flush()
+    first = dict(snap._last_write_stats_)
+    snap._counter += 1
+    snap.export()
+    snap._get_writer().flush()
+    second = dict(snap._last_write_stats_)
+    assert second["bytes_written"] == 0
+    assert second["chunks_deduped"] > 0
+    assert second["bytes_total"] == first["bytes_total"]
+
+
+def test_resolve_and_gc(tmp_path):
+    wf = build(2, tmp_path, snap_kwargs={"format": "shards",
+                                         "min_tensor_bytes": 1})
+    wf.run()
+    snap = wf.snapshotter
+    ckpts = list_checkpoints(str(tmp_path))
+    assert ckpts
+    # every accepted spelling resolves to the same checkpoint dir
+    newest = ckpts[-1]
+    assert resolve_checkpoint(str(tmp_path)) == os.path.realpath(newest)
+    assert resolve_checkpoint(newest) == os.path.realpath(newest)
+    assert resolve_checkpoint(
+        os.path.join(newest, "manifest.json")) == os.path.realpath(newest)
+    # gc with everything retained drops nothing
+    removed, _ = snap.gc()
+    assert removed == 0
+    # keeping only the newest may drop chunks unique to older ones
+    ckpt, man, reader = open_checkpoint(str(tmp_path))
+    removed, _ = snap.gc(keep=[ckpt])
+    for ref in man.tensors:
+        reader.resolve(ref)               # newest still fully readable
+
+
+def test_import_dir_via_generic_restore_routes(tmp_path):
+    wf = build(2, tmp_path, snap_kwargs={"format": "shards",
+                                         "min_tensor_bytes": 1})
+    wf.run()
+    ckpt = resolve_checkpoint(str(tmp_path))
+    for spec in (ckpt, os.path.join(ckpt, "manifest.json"),
+                 str(tmp_path / "blob_current")):
+        back = restore(spec)
+        assert back.restored_from_snapshot
+    assert import_dir(ckpt).restored_from_snapshot
+
+
+# -- generic pytree checkpoints ----------------------------------------------
+
+def test_save_load_state_mixed_pytree(tmp_path):
+    state = {
+        "weights": numpy.random.RandomState(1).standard_normal(
+            (32, 16)).astype(numpy.float32),
+        "step": 1234,
+        "nested": {"ints": numpy.arange(100, dtype=numpy.int64),
+                   "name": "hello"},
+        "listy": [numpy.ones(7), 3.5],
+    }
+    path = save_state(str(tmp_path), "mixed", state)
+    back = load_state(path)
+    assert back["step"] == 1234
+    assert back["nested"]["name"] == "hello"
+    assert numpy.array_equal(back["weights"], state["weights"])
+    assert back["weights"].dtype == numpy.float32
+    assert numpy.array_equal(back["nested"]["ints"],
+                             state["nested"]["ints"])
+    assert numpy.array_equal(back["listy"][0], state["listy"][0])
+    # same-name save replaces
+    state["step"] = 5678
+    path2 = save_state(str(tmp_path), "mixed", state)
+    assert path2 == path
+    assert load_state(path)["step"] == 5678
+
+
+def test_quarantine_partials(tmp_path):
+    torn = tmp_path / "snap.3.ckpt.tmp"
+    torn.mkdir()
+    (torn / "manifest.json").write_text("{}")
+    parts = tmp_path / "snap.3.ckpt.parts"
+    parts.mkdir()
+    moved = quarantine_partials(str(tmp_path))
+    assert len(moved) == 2
+    assert not torn.exists() and not parts.exists()
+    assert all(".quarantine" in m for m in moved)
+
+
+# -- decode KV warm restore ---------------------------------------------------
+
+def test_decode_kv_checkpoint_restores_identical_tokens(tmp_path):
+    """Cut a serving scheduler mid-generation, restore the KV pools +
+    sessions into a fresh scheduler: the resumed sequences emit exactly
+    the tokens the uninterrupted run emits."""
+    import time
+    from veles_tpu.serving import DecodeScheduler
+    from veles_tpu.znicz.samples.flagship import (FlagshipDecodeModel,
+                                                  generate_reference)
+    model = FlagshipDecodeModel(stages=2, experts=2, d=16, heads=2,
+                                hidden=32, vocab=32, seed=0)
+    geom = dict(max_batch=4, block_size=4, max_prompt_len=8,
+                max_new_tokens=64)
+    rng = numpy.random.RandomState(9)
+    prompts = [rng.randint(0, 32, 6).tolist() for _ in range(3)]
+    oracle = [generate_reference(model.params, p, 64) for p in prompts]
+
+    s1 = DecodeScheduler(model, name="kvsrc", **geom)
+    futures = [s1.submit(p, 64) for p in prompts]
+    time.sleep(0.05)                      # land mid-generation
+    path = s1.checkpoint_kv(str(tmp_path))
+    cut_active = s1.active_sequences
+    for f, want in zip(futures, oracle):
+        assert f.result(120)["tokens"] == want
+    s1.close(drain=True)
+
+    s2 = DecodeScheduler(model, name="kvdst", **geom)
+    try:
+        restored = s2.restore_kv(path)
+        assert len(restored) == cut_active
+        for row, future in restored.items():
+            tokens = future.result(120)["tokens"]
+            assert tokens in oracle, \
+                "row %d diverged after restore" % row
+        stats = s2.stats()
+        assert stats["free_blocks"] == stats["num_blocks"] - 1
+    finally:
+        s2.close(drain=True)

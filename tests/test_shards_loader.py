@@ -1,0 +1,234 @@
+"""ShardedBatchLoader (ISSUE 10): streaming minibatches from on-disk
+shards through a bounded window, bit-identical to FullBatchLoader.
+
+The dataset here is integer-valued float32 so the float64 analyze pass
+accumulates exactly (sums of ints < 2^53 are order-independent) — the
+stream comparison below is BITWISE, not allclose."""
+
+import os
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.loader import (FullBatchLoader, ShardedBatchLoader,
+                              write_shards, TEST, VALID, TRAIN)
+from veles_tpu.loader.shards import INDEX, SHARD_FMT
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.workflow import Workflow
+
+CLASSES = [10, 20, 60]          # [test|valid|train] of 90 rows, dim 8
+
+
+def _dataset():
+    rng = numpy.random.RandomState(11)
+    data = rng.randint(0, 9, (90, 8)).astype(numpy.float32)
+    labels = list(int(x) for x in rng.randint(0, 4, 90))
+    return data, labels
+
+
+class _RefLoader(FullBatchLoader):
+    """The in-RAM oracle over the same arrays."""
+
+    def load_data(self):
+        data, labels = _dataset()
+        self.original_data.mem = data
+        self.original_labels = labels
+        (self.class_lengths[TEST], self.class_lengths[VALID],
+         self.class_lengths[TRAIN]) = CLASSES
+
+
+def _write(tmp_path, rows_per_shard=7):
+    data, labels = _dataset()
+    return write_shards(str(tmp_path / "ds"), data, labels=labels,
+                        class_lengths=CLASSES,
+                        rows_per_shard=rows_per_shard)
+
+
+def _sharded(tmp_path, **kwargs):
+    wf = Workflow(name="w")
+    kwargs.setdefault("minibatch_size", 16)
+    kwargs.setdefault("prng", RandomGenerator().seed(5))
+    kwargs.setdefault("path", str(tmp_path / "ds"))
+    loader = ShardedBatchLoader(wf, **kwargs)
+    loader.initialize(device=Device(backend="numpy"))
+    return loader
+
+
+def _reference(**kwargs):
+    wf = Workflow(name="w")
+    kwargs.setdefault("minibatch_size", 16)
+    kwargs.setdefault("prng", RandomGenerator().seed(5))
+    loader = _RefLoader(wf, **kwargs)
+    loader.initialize(device=Device(backend="numpy"))
+    return loader
+
+
+# -- on-disk format -----------------------------------------------------------
+
+def test_write_shards_layout(tmp_path):
+    path = _write(tmp_path)
+    assert os.path.basename(path) == INDEX
+    import json
+    index = json.load(open(path))
+    assert [s["rows"] for s in index["shards"]] == [7] * 12 + [6]
+    assert index["class_lengths"] == CLASSES
+    for k, s in enumerate(index["shards"]):
+        assert s["file"] == SHARD_FMT % k
+        block = numpy.load(os.path.join(os.path.dirname(path), s["file"]))
+        assert block.shape == (s["rows"], 8)
+    data, labels = _dataset()
+    whole = numpy.concatenate(
+        [numpy.load(os.path.join(os.path.dirname(path), s["file"]))
+         for s in index["shards"]])
+    assert numpy.array_equal(whole, data)
+
+
+def test_write_shards_validation(tmp_path):
+    data, labels = _dataset()
+    with pytest.raises(ValueError, match="empty"):
+        write_shards(str(tmp_path / "e"), data[:0])
+    with pytest.raises(ValueError, match="class_lengths"):
+        write_shards(str(tmp_path / "c"), data, class_lengths=[0, 0, 1])
+    with pytest.raises(ValueError, match="labels"):
+        write_shards(str(tmp_path / "l"), data, labels=labels[:-1],
+                     class_lengths=CLASSES)
+
+
+# -- stream parity ------------------------------------------------------------
+
+def test_stream_bit_identical_to_fullbatch(tmp_path):
+    """THE acceptance property: a window one-tenth of the dataset serves
+    the exact minibatch stream the in-RAM loader serves — data, labels,
+    class, size, and epoch flags, bitwise, across epoch wraps."""
+    _write(tmp_path)
+    ref = _reference(normalization_type="mean_disp")
+    sub = _sharded(tmp_path, window_bytes=3 * 7 * 32,   # ~3 of 13 shards
+                   normalization_type="mean_disp")
+    for step in range(40):                               # > 2 epochs
+        ref.run()
+        sub.run()
+        assert sub.minibatch_class == ref.minibatch_class
+        assert sub.minibatch_size == ref.minibatch_size
+        assert bool(sub.epoch_ended) == bool(ref.epoch_ended)
+        assert sub.epoch_number == ref.epoch_number
+        n = ref.minibatch_size
+        assert numpy.array_equal(sub.minibatch_data.map_read()[:n],
+                                 ref.minibatch_data.map_read()[:n]), step
+        assert numpy.array_equal(sub.minibatch_labels.map_read()[:n],
+                                 ref.minibatch_labels.map_read()[:n])
+    assert sub.window_used_bytes <= 3 * 7 * 32
+    assert sub.shard_loads > 13          # tiny window: re-reads happened
+
+
+def test_window_never_exceeds_budget(tmp_path):
+    _write(tmp_path)
+    budget = 2 * 7 * 32
+    sub = _sharded(tmp_path, window_bytes=budget)
+    for _ in range(30):
+        sub.run()
+        assert sub.window_used_bytes <= budget
+        assert len(sub.shards_cached) <= 2
+
+
+def test_full_window_loads_each_shard_once(tmp_path):
+    """With the window covering the dataset, Belady never evicts: 13
+    loads total no matter how many epochs run."""
+    _write(tmp_path)
+    sub = _sharded(tmp_path, window_bytes=1 << 20)
+    for _ in range(40):
+        sub.run()
+    assert sub.shard_loads == 13
+
+
+def test_windowed_mode_sequential_io_and_determinism(tmp_path):
+    """shuffle_mode="windowed": shard order + intra-shard rows permute,
+    so a 2-shard window streams each shard ~once per epoch (vs the
+    global shuffle's random access), deterministically."""
+    _write(tmp_path)
+    budget = 2 * 7 * 32
+
+    def stream(mode):
+        sub = _sharded(tmp_path, window_bytes=budget, shuffle_mode=mode)
+        seen = []
+        for _ in range(21):  # 3 epochs of 7 steps (10+20+60 @ mb 16,
+            sub.run()        # minibatches never span class boundaries)
+            seen.append(numpy.array(
+                sub.minibatch_data.map_read()[:sub.minibatch_size]))
+        return sub, seen
+
+    win, seen_a = stream("windowed")
+    win2, seen_b = stream("windowed")
+    glob, _ = stream("global")
+    for a, b in zip(seen_a, seen_b, strict=True):
+        assert numpy.array_equal(a, b)           # deterministic
+    assert win.shard_loads < glob.shard_loads / 2
+    # every epoch still serves each row exactly once
+    data, _ = _dataset()
+    epoch = numpy.concatenate(seen_a[:7])
+    assert numpy.array_equal(
+        numpy.sort(epoch.ravel()), numpy.sort(data.ravel()))
+
+
+def test_window_state_is_transient(tmp_path):
+    """The shard cache never rides into a pickle (checkpoints stay
+    O(model), not O(window)) and rebuilds empty on restore."""
+    _write(tmp_path)
+    sub = _sharded(tmp_path, window_bytes=1 << 20)
+    for _ in range(5):
+        sub.run()
+    assert sub.shard_loads > 0
+    state = sub.__getstate__()
+    assert "_window_" not in state
+    blob = pickle.dumps(sub)
+    assert len(blob) < 64 << 10
+    back = pickle.loads(blob)
+    assert back.shard_loads == 0 and not back.shards_cached
+
+
+# -- end-to-end under StandardWorkflow ---------------------------------------
+
+def test_standard_workflow_trains_bitwise_vs_fullbatch(tmp_path):
+    """Swap the loader under an unmodified StandardWorkflow: trained
+    weights are bitwise equal to the FullBatchLoader run, with the
+    minibatch prefetcher attached (regression: the prefetcher's serving
+    twin shares the window dict, so shard accounting stays visible on
+    the real loader)."""
+    from test_standard_workflow import BlobLoader, LAYERS
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+    import veles_tpu.prng.random_generator as rg
+
+    probe = BlobLoader(Workflow(name="probe"),
+                       prng=RandomGenerator().seed(5))
+    probe.load_data()
+    write_shards(str(tmp_path / "blobs"),
+                 numpy.asarray(probe.original_data.mem),
+                 labels=probe.original_labels,
+                 class_lengths=list(probe.class_lengths),
+                 rows_per_shard=16)
+
+    def build(factory, loader_kwargs):
+        rg._generators.clear()
+        rg.get(0).seed(77)
+        kwargs = dict(minibatch_size=25, prng=RandomGenerator().seed(5))
+        kwargs.update(loader_kwargs)
+        wf = StandardWorkflow(
+            None, name="std", loader_factory=factory, loader=kwargs,
+            layers=LAYERS, loss_function="softmax",
+            decision={"max_epochs": 4, "silent": True}, fused=True)
+        wf.initialize(device=Device(backend="cpu"))
+        return wf
+
+    ref = build(BlobLoader, {})
+    ref.run()
+    sub = build(ShardedBatchLoader,
+                {"path": str(tmp_path / "blobs"),
+                 "window_bytes": 3 * 16 * 32})
+    sub.run()
+    assert sub.loader.shard_loads > 0        # visible through the twin
+    assert sub.loader.window_used_bytes <= 3 * 16 * 32
+    for a, b in zip(ref.forwards, sub.forwards):
+        assert numpy.array_equal(a.weights.map_read(),
+                                 b.weights.map_read())
+        assert numpy.array_equal(a.bias.map_read(), b.bias.map_read())

@@ -1,0 +1,171 @@
+"""Inspect sharded checkpoints: manifests, tensors, dedupe, integrity.
+
+Usage::
+
+    python tools/ckpt_inspect.py SNAPSHOT_ROOT            # list + dedupe
+    python tools/ckpt_inspect.py PATH/TO/name.3.ckpt      # per-tensor dump
+    python tools/ckpt_inspect.py SNAPSHOT_ROOT --verify   # re-hash chunks
+    python tools/ckpt_inspect.py ... --json               # machine output
+
+``PATH`` accepts anything :func:`resolve_checkpoint` does: a snapshot
+root, a checkpoint directory, a ``*_current`` symlink, or a
+``manifest.json``.  ``--verify`` re-hashes every chunk the manifest(s)
+reference straight off disk — read-only, unlike ``ChunkStore.get``
+which quarantines on mismatch.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from veles_tpu.checkpoint.manifest import (CHUNKS_DIR, CKPT_SUFFIX,
+                                           Manifest, list_checkpoints)
+from veles_tpu.checkpoint.snapshot import resolve_checkpoint
+from veles_tpu.checkpoint.store import SUFFIX as CHUNK_SUFFIX
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return "%.1f %s" % (n, unit) if unit != "B" else "%d B" % n
+        n /= 1024.0
+
+
+def describe_checkpoint(ckpt):
+    """One checkpoint -> {meta, tensors: [{ref, shape, dtype, sharding,
+    chunks, bytes}], total_bytes, digests}."""
+    man = Manifest.load_dir(ckpt)
+    tensors = []
+    for ref in sorted(man.tensors):
+        e = man.tensors[ref]
+        tensors.append({"ref": ref, "shape": e["shape"],
+                        "dtype": e["dtype"],
+                        "sharding": e.get("sharding"),
+                        "chunks": len(e["chunks"]),
+                        "bytes": man.tensor_bytes(ref)})
+    return {"path": ckpt, "meta": man.meta, "tensors": tensors,
+            "total_bytes": man.total_bytes(),
+            "digests": sorted(man.digests())}
+
+
+def describe_root(root):
+    """Every checkpoint under a snapshot root + the cross-checkpoint
+    dedupe accounting the shared chunks/ dir buys."""
+    ckpts = [describe_checkpoint(c) for c in list_checkpoints(root)]
+    referenced = sum(c["total_bytes"] for c in ckpts)
+    live = set()
+    for c in ckpts:
+        live.update(c["digests"])
+    store_dir = os.path.join(root, CHUNKS_DIR)
+    on_disk = orphans = 0
+    try:
+        for name in os.listdir(store_dir):
+            if not name.endswith(CHUNK_SUFFIX):
+                continue
+            size = os.path.getsize(os.path.join(store_dir, name))
+            on_disk += size
+            if name[:-len(CHUNK_SUFFIX)] not in live:
+                orphans += size
+    except OSError:
+        pass
+    return {"root": root, "checkpoints": ckpts,
+            "referenced_bytes": referenced,
+            "stored_bytes": on_disk,
+            "orphan_bytes": orphans,
+            "dedupe_ratio": (round(referenced / on_disk, 2)
+                             if on_disk else None)}
+
+
+def verify_chunks(root, digests):
+    """Re-hash each referenced chunk off disk (read-only)."""
+    store_dir = os.path.join(root, CHUNKS_DIR)
+    missing, corrupt = [], []
+    for digest in sorted(digests):
+        path = os.path.join(store_dir, digest + CHUNK_SUFFIX)
+        try:
+            with open(path, "rb") as f:
+                actual = hashlib.sha256(f.read()).hexdigest()
+        except OSError:
+            missing.append(digest)
+            continue
+        if actual != digest:
+            corrupt.append(digest)
+    return {"verified": len(digests) - len(missing) - len(corrupt),
+            "missing": missing, "corrupt": corrupt}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="snapshot root, checkpoint dir, "
+                                 "_current symlink, or manifest.json")
+    ap.add_argument("--verify", action="store_true",
+                    help="re-hash every referenced chunk")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON document instead of text")
+    args = ap.parse_args(argv)
+
+    path = os.path.realpath(args.path)
+    if os.path.isdir(path) and not path.endswith(CKPT_SUFFIX) and \
+            list_checkpoints(path):
+        doc = describe_root(path)
+        root = path
+    else:
+        ckpt = resolve_checkpoint(args.path)
+        doc = describe_checkpoint(ckpt)
+        root = os.path.dirname(ckpt)
+    if args.verify:
+        digests = set(doc.get("digests", ()))
+        for c in doc.get("checkpoints", ()):
+            digests.update(c["digests"])
+        doc["verify"] = verify_chunks(root, digests)
+
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 0 if not doc.get("verify") or (
+            not doc["verify"]["missing"] and
+            not doc["verify"]["corrupt"]) else 1
+
+    if "checkpoints" in doc:
+        print("snapshot root %s" % doc["root"])
+        for c in doc["checkpoints"]:
+            print("  %-40s %3d tensors  %10s  %s" %
+                  (os.path.basename(c["path"]), len(c["tensors"]),
+                   _fmt_bytes(c["total_bytes"]),
+                   c["meta"].get("kind", "")))
+        print("referenced %s across %d checkpoint(s); stored %s "
+              "(dedupe %sx, orphans %s)" %
+              (_fmt_bytes(doc["referenced_bytes"]),
+               len(doc["checkpoints"]),
+               _fmt_bytes(doc["stored_bytes"]),
+               doc["dedupe_ratio"], _fmt_bytes(doc["orphan_bytes"])))
+    else:
+        print("checkpoint %s" % doc["path"])
+        if doc["meta"]:
+            print("  meta: %s" % json.dumps(doc["meta"], sort_keys=True))
+        for t in doc["tensors"]:
+            print("  %-32s %-18s %-10s %3d chunk(s) %10s  %s" %
+                  (t["ref"], tuple(t["shape"]), t["dtype"], t["chunks"],
+                   _fmt_bytes(t["bytes"]), t["sharding"] or ""))
+        print("total %s in %d tensor(s)" %
+              (_fmt_bytes(doc["total_bytes"]), len(doc["tensors"])))
+
+    if "verify" in doc:
+        v = doc["verify"]
+        print("verify: %d chunk(s) ok, %d missing, %d corrupt" %
+              (v["verified"], len(v["missing"]), len(v["corrupt"])))
+        for digest in v["missing"]:
+            print("  MISSING %s" % digest)
+        for digest in v["corrupt"]:
+            print("  CORRUPT %s" % digest)
+        if v["missing"] or v["corrupt"]:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""Sharded, content-addressed tensor checkpoints (ISSUE 10).
+
+Public surface:
+
+- :class:`SnapshotterToShards` — drop-in snapshotter backend
+  (``root.common.snapshot.format = "shards"``) writing per-process
+  tensor shards as deduplicated chunks under a manifest;
+- :func:`import_dir` / :func:`open_checkpoint` — restore a workflow /
+  open a manifest for inspection or shard-wise tensor rebuilds;
+- :func:`save_state` / :func:`load_state` — checkpoint arbitrary
+  tensor pytrees (decode KV pools, tools);
+- :class:`ChunkStore`, :class:`Manifest`, :class:`TensorReader` — the
+  storage primitives, for tools and tests.
+"""
+
+from .manifest import (CHUNKS_DIR, CKPT_SUFFIX, MANIFEST, TOPOLOGY,
+                       Manifest, list_checkpoints)
+from .snapshot import (SnapshotterToShards, import_dir, is_shard_checkpoint,
+                       load_state, open_checkpoint, quarantine_partials,
+                       resolve_checkpoint, save_state)
+from .store import ChunkStore, CorruptChunkError
+from .tensors import (ExtractingPickler, ResolvingUnpickler,
+                      TensorReader, TensorSink, TensorStub,
+                      extracting, restoring)
+
+__all__ = [
+    "CHUNKS_DIR", "CKPT_SUFFIX", "MANIFEST", "TOPOLOGY",
+    "Manifest", "list_checkpoints",
+    "SnapshotterToShards", "import_dir", "is_shard_checkpoint",
+    "load_state", "open_checkpoint", "quarantine_partials",
+    "resolve_checkpoint", "save_state",
+    "ChunkStore", "CorruptChunkError",
+    "ExtractingPickler", "ResolvingUnpickler",
+    "TensorReader", "TensorSink", "TensorStub",
+    "extracting", "restoring",
+]

@@ -1,0 +1,396 @@
+"""Tensor extraction, chunked serialization, and resharded restore.
+
+The snapshotter's capture phase walks the workflow with
+``copy.deepcopy`` through the ``Pickleable.__getstate__`` machinery.
+Sharded checkpoints hook that walk: inside an :func:`extracting`
+context every large tensor — a device-dirty ``memory.Array`` payload
+(handled in ``Array.__getstate__``) or a plain host ``numpy.ndarray``
+(solver state; handled by a deepcopy dispatch hook here) — is diverted
+into a :class:`TensorSink` and replaced by a tiny :class:`TensorStub`.
+The topology pickle that reaches the writer thread therefore carries no
+tensor payload; the writer serializes the sink's tensors as
+content-addressed chunks instead, each process writing only its
+``addressable_shards`` (``replica_id == 0`` — every unique piece of
+data is written exactly once globally, the discipline of distributed
+checkpointing in arXiv 2112.09017).
+
+Restore is the mirror: ``TensorStub.__reduce__`` resolves through the
+:func:`restoring` context, so ordinary ``pickle.load`` of the topology
+rebuilds every tensor in place — assembled on host from the manifest's
+chunks, or (via :meth:`TensorReader.restore_array`) materialized
+per-shard onto the *restoring* process's mesh, reading only the chunks
+that overlap each local shard.
+"""
+
+import contextlib
+import io
+import pickle
+import threading
+
+import numpy
+
+_TLS = threading.local()
+
+
+def _payload_nbytes(value):
+    try:
+        return int(value.nbytes)
+    except Exception:  # noqa: BLE001 — anything unsized is not a tensor
+        return 0
+
+
+class TensorSink:
+    """Collects tensor payloads extracted during one capture walk.
+
+    Host numpy values are copied at capture (training keeps mutating
+    the original); jax Arrays are immutable and kept zero-copy — the
+    device→host pull happens on the writer thread, not the step loop.
+    """
+
+    def __init__(self, min_bytes=65536):
+        self.min_bytes = int(min_bytes)
+        self.tensors = {}            # ref -> numpy copy | jax.Array
+        self._n = 0
+        self._by_id = {}             # id(value) -> ref (shared-array dedupe)
+
+    def add(self, value, copy=False):
+        ref = self._by_id.get(id(value))
+        if ref is not None and self.tensors[ref] is value:
+            return ref
+        if copy:
+            value = numpy.array(value)
+        ref = "t%05d" % self._n
+        self._n += 1
+        self.tensors[ref] = value
+        self._by_id[id(value)] = ref
+        return ref
+
+    @property
+    def nbytes(self):
+        return sum(_payload_nbytes(v) for v in self.tensors.values())
+
+
+def active_sink():
+    return getattr(_TLS, "sink", None)
+
+
+def active_source():
+    return getattr(_TLS, "source", None)
+
+
+@contextlib.contextmanager
+def extracting(sink):
+    """Divert large ``memory.Array`` payloads seen by pickle/deepcopy
+    into ``sink`` (consulted by ``Array.__getstate__``)."""
+    prev = active_sink()
+    _TLS.sink = sink
+    try:
+        yield sink
+    finally:
+        _TLS.sink = prev
+
+
+@contextlib.contextmanager
+def restoring(source):
+    """Resolve :class:`TensorStub` references through ``source``
+    (anything with a ``resolve(ref)`` method) during ``pickle.load``."""
+    prev = active_source()
+    _TLS.source = source
+    try:
+        yield source
+    finally:
+        _TLS.source = prev
+
+
+def _resolve(ref):
+    src = active_source()
+    if src is None:
+        raise RuntimeError(
+            "TensorStub %r resolved outside a checkpoint restore "
+            "context — load sharded checkpoints via "
+            "checkpoint.import_dir()/snapshotter.restore(), not bare "
+            "pickle.load" % ref)
+    return src.resolve(ref)
+
+
+class TensorStub:
+    """Pickles as a call to ``_resolve(ref)`` — restore rebuilds the
+    tensor in place, even inside tuples/dicts pickle reconstructs."""
+
+    __slots__ = ("ref",)
+
+    def __init__(self, ref):
+        self.ref = ref
+
+    def __reduce__(self):
+        return (_resolve, (self.ref,))
+
+    def __deepcopy__(self, memo):
+        return self                  # immutable marker
+
+    def __repr__(self):
+        return "<TensorStub %s>" % self.ref
+
+
+class ExtractingPickler(pickle.Pickler):
+    """Pickler diverting the remaining large tensors — plain host
+    ndarrays (solver state inside gd units) and bare jax Arrays — into
+    the sink via pickle's persistent-id protocol.
+
+    This runs on the WRITER thread over the frozen capture twin, which
+    is why plain ndarrays are NOT hooked at deepcopy time: a deepcopy
+    hook would hand :class:`TensorStub` markers to ``__setstate__``
+    methods that interpret their state eagerly (numpy's RandomState
+    rejects them), whereas at load time pickle resolves every reference
+    before any ``__setstate__`` sees it.  The twin is frozen, so the
+    sink takes the arrays zero-copy."""
+
+    def __init__(self, file, sink, protocol=pickle.HIGHEST_PROTOCOL):
+        super().__init__(file, protocol)
+        self._sink = sink
+
+    def persistent_id(self, obj):
+        sink = self._sink
+        if isinstance(obj, numpy.ndarray):
+            if obj.dtype != numpy.object_ and \
+                    obj.nbytes >= sink.min_bytes:
+                return sink.add(obj)
+            return None
+        if hasattr(obj, "addressable_shards") and \
+                _payload_nbytes(obj) >= sink.min_bytes:
+            return sink.add(obj)
+        return None
+
+
+def dumps_extracting(obj, sink):
+    buf = io.BytesIO()
+    ExtractingPickler(buf, sink).dump(obj)
+    return buf.getvalue()
+
+
+class ResolvingUnpickler(pickle.Unpickler):
+    """Mirror of :class:`ExtractingPickler`: persistent ids resolve
+    through a :class:`TensorReader` (stub references resolve through
+    the surrounding :func:`restoring` context)."""
+
+    def __init__(self, file, reader):
+        super().__init__(file)
+        self._reader = reader
+
+    def persistent_load(self, ref):
+        return self._reader.resolve(ref)
+
+
+# -- dtype naming (manifest is JSON; bf16 etc. are not stock numpy) ----------
+
+def dtype_name(dt):
+    return numpy.dtype(dt).name
+
+
+def dtype_from(name):
+    try:
+        return numpy.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return numpy.dtype(getattr(ml_dtypes, name))
+
+
+# -- shard / chunk planning ---------------------------------------------------
+
+def global_shape(value):
+    return tuple(int(d) for d in value.shape)
+
+
+def sharding_spec(value):
+    """JSON-able description of a jax.Array's sharding (None for host
+    tensors).  Informational: restore reshards onto whatever mesh the
+    restoring process asks for; this records what the *saving* run had
+    (surfaced by tools/ckpt_inspect.py)."""
+    sharding = getattr(value, "sharding", None)
+    if sharding is None:
+        return None
+    try:
+        mesh = {str(name): int(size) for name, size in
+                zip(sharding.mesh.axis_names, sharding.mesh.devices.shape)}
+        parts = []
+        for p in tuple(sharding.spec):
+            if p is None:
+                parts.append(None)
+            elif isinstance(p, (list, tuple)):
+                parts.append([str(q) for q in p])
+            else:
+                parts.append(str(p))
+        return {"mesh": mesh, "spec": parts}
+    except Exception:  # noqa: BLE001 — e.g. SingleDeviceSharding
+        return {"repr": repr(sharding)}
+
+
+def local_blocks(value):
+    """Yield ``(global_offset, numpy_block)`` for the pieces THIS
+    process must write.  jax Arrays: addressable shards with
+    ``replica_id == 0`` (each unique piece written once globally; the
+    device→host pull happens here, on the writer thread).  Host numpy:
+    the whole array — the caller skips host tensors on processes != 0,
+    where they are replicas of process 0's."""
+    if hasattr(value, "addressable_shards"):
+        for shard in value.addressable_shards:
+            if shard.replica_id != 0:
+                continue
+            base = tuple(int(sl.start or 0) for sl in shard.index)
+            yield base, numpy.asarray(shard.data)
+    else:
+        arr = numpy.asarray(value)
+        yield (0,) * arr.ndim, arr
+
+
+def iter_block_chunks(base, block, chunk_bytes):
+    """Split one contiguous block (at global offset ``base``) into
+    leading-axis bands of ~``chunk_bytes`` each."""
+    if block.size == 0:
+        return
+    if block.ndim == 0:
+        yield base, block
+        return
+    row_bytes = max(block.nbytes // max(len(block), 1), 1)
+    rows = max(int(chunk_bytes // row_bytes), 1)
+    for off in range(0, len(block), rows):
+        piece = block[off:off + rows]
+        yield (base[0] + off,) + tuple(base[1:]), piece
+
+
+def write_tensors(store, sink, chunk_bytes, host_tensors=True):
+    """Serialize every sink tensor into ``store``; returns
+    ``(entries, stats)`` where ``entries`` maps ref -> manifest entry.
+    ``host_tensors=False`` skips plain-numpy payloads (multi-host
+    processes != 0: host state is a replica of process 0's)."""
+    entries = {}
+    stats = {"bytes_written": 0, "bytes_total": 0,
+             "chunks_written": 0, "chunks_deduped": 0}
+    for ref, value in sink.tensors.items():
+        is_jax = hasattr(value, "addressable_shards")
+        chunks = []
+        if is_jax or host_tensors:
+            for base, block in local_blocks(value):
+                # NOT ascontiguousarray: it promotes 0-d to shape (1,)
+                block = numpy.asarray(block)
+                for off, piece in iter_block_chunks(
+                        base, block, chunk_bytes):
+                    if not piece.flags.c_contiguous:
+                        piece = numpy.ascontiguousarray(piece)
+                    digest, written = store.put(piece.data)
+                    stats["bytes_total"] += piece.nbytes
+                    if written:
+                        stats["bytes_written"] += written
+                        stats["chunks_written"] += 1
+                    else:
+                        stats["chunks_deduped"] += 1
+                    chunks.append({"offset": list(off),
+                                   "shape": list(piece.shape),
+                                   "digest": digest,
+                                   "bytes": piece.nbytes})
+        entries[ref] = {"shape": list(global_shape(value)),
+                        "dtype": dtype_name(value.dtype),
+                        "sharding": sharding_spec(value),
+                        "chunks": chunks}
+    return entries, stats
+
+
+# -- restore ------------------------------------------------------------------
+
+def _norm_index(index, shape):
+    """Normalize a shard index (tuple of slices, possibly open-ended)
+    to concrete [start, stop) bounds."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _overlap(dst_bounds, chunk_off, chunk_shape):
+    """(dst_slices, src_slices) of the intersection, or None."""
+    dst_sl, src_sl = [], []
+    for (a, b), o, s in zip(dst_bounds, chunk_off, chunk_shape):
+        lo, hi = max(a, o), min(b, o + s)
+        if hi <= lo:
+            return None
+        dst_sl.append(slice(lo - a, hi - a))
+        src_sl.append(slice(lo - o, hi - o))
+    return tuple(dst_sl), tuple(src_sl)
+
+
+class TensorReader:
+    """Resolves manifest tensors from a chunk store.
+
+    ``resolve(ref)`` assembles the full tensor on host (the default
+    restore path: peak memory is one tensor, not the whole model twice).
+    ``restore_array(ref, sharding)`` builds a jax.Array directly onto
+    the restoring process's mesh, reading ONLY the chunks overlapping
+    each addressable shard — the beyond-host-RAM path.
+    """
+
+    def __init__(self, store, manifest):
+        self.store = store
+        self.manifest = manifest
+        self.bytes_read = 0
+        #: optional hard cap on a single host assembly (set by callers
+        #: proving the beyond-RAM path; None = unlimited)
+        self.max_resolve_bytes = None
+
+    def entry(self, ref):
+        try:
+            return self.manifest.tensors[ref]
+        except KeyError:
+            raise KeyError("checkpoint manifest has no tensor %r" % ref)
+
+    def _chunk_array(self, chunk, dtype):
+        data = self.store.get(chunk["digest"])
+        self.bytes_read += len(data)
+        return numpy.frombuffer(data, dtype).reshape(chunk["shape"])
+
+    def resolve(self, ref):
+        e = self.entry(ref)
+        dtype = dtype_from(e["dtype"])
+        shape = tuple(e["shape"])
+        nbytes = int(numpy.prod(shape, dtype=numpy.int64)) * dtype.itemsize
+        if self.max_resolve_bytes is not None and \
+                nbytes > self.max_resolve_bytes:
+            raise MemoryError(
+                "tensor %s (%d bytes) exceeds the per-process host "
+                "assembly cap (%d); restore it shard-wise via "
+                "restore_array(ref, sharding)" % (
+                    ref, nbytes, self.max_resolve_bytes))
+        out = numpy.empty(shape, dtype)
+        for c in e["chunks"]:
+            if not shape:
+                out[...] = self._chunk_array(c, dtype)
+                continue
+            region = tuple(slice(o, o + s)
+                           for o, s in zip(c["offset"], c["shape"]))
+            out[region] = self._chunk_array(c, dtype)
+        return out
+
+    def restore_array(self, ref, sharding):
+        import jax
+        e = self.entry(ref)
+        dtype = dtype_from(e["dtype"])
+        shape = tuple(e["shape"])
+        chunks = e["chunks"]
+
+        def cb(index):
+            if not shape:
+                return self._chunk_array(chunks[0], dtype) \
+                    if chunks else numpy.zeros((), dtype)
+            bounds = _norm_index(index, shape)
+            out = numpy.zeros(
+                tuple(b - a for a, b in bounds), dtype)
+            for c in chunks:
+                ov = _overlap(bounds, c["offset"], c["shape"])
+                if ov is None:
+                    continue
+                dst_sl, src_sl = ov
+                out[dst_sl] = self._chunk_array(c, dtype)[src_sl]
+            return out
+
+        return jax.make_array_from_callback(shape, sharding, cb)

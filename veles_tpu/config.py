@@ -304,6 +304,17 @@ root.update({
             # _report_size fattest-units diagnostic threshold, bytes
             # (0 disables)
             "report_size_threshold": 64 << 20,
+            # snapshot backend: "pickle" (SnapshotterToFile, the
+            # default — whole-workflow pickle, one host holds it all)
+            # or "shards" (checkpoint/SnapshotterToShards — every
+            # process writes its addressable shards as content-
+            # addressed chunks; restores onto any mesh shape)
+            "format": "pickle",
+            # sharded backend: target chunk size for tensor bands
+            "chunk_bytes": 16 << 20,
+            # tensors smaller than this stay inline in the topology
+            # pickle instead of becoming chunked shards
+            "min_tensor_bytes": 65536,
         },
         "trace": {"enabled": False, "file": None},
         "timings": set(),

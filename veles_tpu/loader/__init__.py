@@ -11,6 +11,7 @@ from .image import (ImageLoader, FileImageLoader,           # noqa: F401
 from .pickles import (PicklesLoader, Hdf5Loader,            # noqa: F401
                       FileListLoader)
 from .prefetch import MinibatchPrefetcher, PrefetchError    # noqa: F401
+from .shards import ShardedBatchLoader, write_shards        # noqa: F401
 from .saver import MinibatchesSaver, MinibatchesLoader      # noqa: F401
 from .stream import StreamLoader                            # noqa: F401
 from .sound import SndFileLoader                            # noqa: F401

@@ -17,6 +17,38 @@ from ..memory import Array
 from .. import normalization
 from .base import Loader, TRAIN, VALID
 
+#: row-band size for the cast+normalize pass (bounds the transient)
+CAST_CHUNK_BYTES = 64 << 20
+
+
+def cast_normalized(arr, dtype, normalizer, chunk_bytes=CAST_CHUNK_BYTES):
+    """Cast the dataset Array ``arr`` to ``dtype`` and bake ``normalizer``
+    in WITHOUT a second full-size copy: a same-dtype dataset is
+    normalized in place, band by band; a dtype change allocates the
+    destination exactly once and converts row bands through a small
+    transient.  Every normalizer transforms rows independently, so
+    banding is bit-exact vs the whole-array pass.  Returns the resident
+    ndarray (also assigned back to ``arr.mem``)."""
+    src = arr.map_write()
+    apply = not isinstance(normalizer, normalization.NoneNormalizer)
+    dtype = numpy.dtype(dtype)
+    row_bytes = max(int(src[:1].nbytes), 1) if len(src) else 1
+    rows = max(1, int(chunk_bytes) // row_bytes)
+    if src.dtype == dtype:
+        if apply:
+            for i in range(0, len(src), rows):
+                normalizer.normalize(src[i:i + rows])
+        arr.mem = src
+        return src
+    dst = numpy.empty(src.shape, dtype)
+    for i in range(0, len(src), rows):
+        band = src[i:i + rows].astype(dtype)
+        if apply:
+            normalizer.normalize(band)
+        dst[i:i + rows] = band
+    arr.mem = dst
+    return dst
+
 
 class FullBatchLoader(Loader):
     """Dataset-as-one-Array loader with device-side gather.
@@ -76,10 +108,7 @@ class FullBatchLoader(Loader):
     def prepare_restored_dataset(self):
         """Bake the (current or restored) normalizer state into the
         resident dataset and build the dense label table."""
-        data = self.original_data.map_write().astype(self._dtype)
-        if not isinstance(self.normalizer, normalization.NoneNormalizer):
-            self.normalizer.normalize(data)
-        self.original_data.mem = data
+        cast_normalized(self.original_data, self._dtype, self.normalizer)
         # labels → dense int mapping once, host-side
         if self.has_labels:
             self._dense_labels = numpy.zeros(len(self.original_labels),
@@ -186,11 +215,8 @@ class FullBatchLoaderMSE(FullBatchLoader):
 
     def prepare_restored_dataset(self):
         super().prepare_restored_dataset()
-        targets = self.original_targets.map_write().astype(self._dtype)
-        if not isinstance(self.targets_normalizer,
-                          normalization.NoneNormalizer):
-            self.targets_normalizer.normalize(targets)
-        self.original_targets.mem = targets
+        cast_normalized(self.original_targets, self._dtype,
+                        self.targets_normalizer)
 
     def _gather_sources(self):
         return [(self.original_data.devmem, self.minibatch_data),

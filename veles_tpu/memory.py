@@ -244,7 +244,24 @@ class Array(Pickleable):
     def __getstate__(self):
         """Device values are pulled to host before pickling (reference
         memory.py:284-299); shallow_pickle drops the payload for huge
-        datasets."""
+        datasets.  Inside a sharded-checkpoint extraction context
+        (checkpoint/tensors.py) large payloads are diverted into the
+        sink instead: a device-current value is handed over zero-copy
+        as its immutable jax.Array — no device→host pull on the capture
+        thread — and a host-current value is snapshotted once."""
+        from .checkpoint.tensors import TensorStub, active_sink
+        sink = active_sink()
+        if sink is not None and not self.shallow_pickle:
+            if self._device_dirty_ and self._devmem_ is not None:
+                payload, needs_copy = self._devmem_, False
+            else:
+                payload, needs_copy = self._mem, True
+            nbytes = getattr(payload, "nbytes", None)  # None: already a stub
+            if nbytes is not None and nbytes >= sink.min_bytes:
+                state = super().__getstate__()
+                state["_mem"] = TensorStub(
+                    sink.add(payload, copy=needs_copy))
+                return state
         self.map_read()
         state = super().__getstate__()
         if self.shallow_pickle:

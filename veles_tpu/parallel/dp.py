@@ -54,7 +54,7 @@ class DistributedTrainStep(FusedTrainStep):
 
     def initialize(self, device=None, **kwargs):
         if isinstance(self.mesh, dict):   # restored from a snapshot
-            self.mesh = mesh_mod.make_mesh(self.mesh)
+            self.mesh = mesh_mod.mesh_for_spec(self.mesh)
         super().initialize(device=device, **kwargs)
         import jax
         import numpy

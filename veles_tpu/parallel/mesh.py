@@ -40,6 +40,26 @@ def make_mesh(axes=None, devices=None):
     return Mesh(dev_array, tuple(names))
 
 
+def mesh_for_spec(spec, devices=None):
+    """Rebuild a Mesh from a pickled :func:`mesh_spec` on THIS process.
+
+    Unlike :func:`make_mesh` the spec need not cover every local device:
+    the first ``prod(sizes)`` devices are taken, so a snapshot written
+    on a small topology restores on a bigger host unchanged (and the
+    caller may always assign a different Mesh before initialize for a
+    true cross-mesh restore)."""
+    import jax
+    sizes = [int(s) for s in dict(spec).values()]
+    if -1 in sizes:
+        return make_mesh(spec, devices)
+    n = int(numpy.prod(sizes))
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n:
+        raise ValueError("mesh %s needs %d devices; this process has %d"
+                         % (dict(spec), n, len(devices)))
+    return make_mesh(spec, devices[:n])
+
+
 def mesh_spec(mesh):
     """Picklable ``{axis: size}`` geometry of a Mesh.  jax Device
     handles are process-local and cannot be pickled — snapshots store

@@ -48,7 +48,7 @@ class DistributedScanStep(ScanEpochStep):
 
     def initialize(self, device=None, **kwargs):
         if isinstance(self.mesh, dict):   # restored from a snapshot
-            self.mesh = mesh_mod.make_mesh(self.mesh)
+            self.mesh = mesh_mod.mesh_for_spec(self.mesh)
         return super().initialize(device=device, **kwargs)
 
     # ScanEpochStep.initialize calls these AFTER the params/opt/macc and

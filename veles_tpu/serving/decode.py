@@ -63,6 +63,17 @@ class _Request:
         self.trace = _trace.current()
 
 
+class _Job:
+    """A callable the WORKER runs between token steps — the only safe
+    place to touch pools/table/sessions (checkpoint_kv/restore_kv)."""
+
+    __slots__ = ("fn", "future")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.future = Future()
+
+
 class _Session:
     """One admitted sequence: its row, blocks, and token state."""
 
@@ -323,6 +334,14 @@ class DecodeScheduler:
                 if item is _STOP:
                     stop = True
                     break
+                if isinstance(item, _Job):
+                    # step boundary: no executable in flight, worker
+                    # owns every mutable — run the job inline
+                    try:
+                        item.future.set_result(item.fn())
+                    except Exception as exc:  # noqa: BLE001 — to caller
+                        item.future.set_exception(exc)
+                    continue
                 self._pending.append(item)
             if self._abort:
                 self._cancel_all()
@@ -461,6 +480,114 @@ class DecodeScheduler:
                     "ttft_s": round(session.first_token_s, 6),
                 })
         self._release()
+
+    # -- KV checkpoint / restore ---------------------------------------------
+    def _run_job(self, fn, timeout=120.0):
+        """Ship ``fn`` to the worker thread and wait for its result
+        (the worker runs jobs only at step boundaries)."""
+        if self._closed:
+            raise SchedulerClosed("decode scheduler %r is draining"
+                                  % self.name)
+        job = _Job(fn)
+        self._queue.put(job)
+        return job.future.result(timeout)
+
+    def checkpoint_kv(self, directory, name="kv"):
+        """Checkpoint the complete decode state — device K/V pools,
+        page table mirrors, block-pool accounting and every live
+        session's token state — as a sharded checkpoint under
+        ``directory``.  Runs on the worker at a step boundary, so the
+        captured state is a consistent token-step cut.  Returns the
+        checkpoint path."""
+        return self._run_job(lambda: self._checkpoint_kv(directory,
+                                                         name))
+
+    def restore_kv(self, path):
+        """Load a :meth:`checkpoint_kv` checkpoint into this (idle)
+        scheduler and resume its sequences mid-generation.  Geometry
+        must match.  Returns ``{row: Future}`` — the futures of the
+        resumed sequences (their original futures died with the old
+        process).  Decoding continues immediately; the restored
+        sequences emit exactly the tokens the uninterrupted run would
+        have."""
+        return self._run_job(lambda: self._restore_kv(path))
+
+    def _checkpoint_kv(self, directory, name):
+        from ..checkpoint import save_state
+        state = {
+            "geometry": {
+                "max_batch": self.max_batch,
+                "block_size": self.block_size,
+                "max_prompt_len": self.max_prompt_len,
+                "max_new_tokens": self.max_new_tokens,
+                "num_blocks": self._pool.num_blocks,
+            },
+            "k_pools": self._k_pools,
+            "v_pools": self._v_pools,
+            "table": self._np_table.copy(),
+            "lengths": self._np_lengths.copy(),
+            "tokens": self._np_tokens.copy(),
+            "pool": {"free": [int(b) for b in self._pool._free],
+                     "live": sorted(int(b) for b in self._pool._live)},
+            "sessions": [{
+                "row": int(s.row),
+                "blocks": [int(b) for b in s.blocks],
+                "length": int(s.length),
+                "next_input": int(s.next_input),
+                "generated": [int(t) for t in s.generated],
+                "first_token_s": float(s.first_token_s or 0.0),
+                "prompt": numpy.array(s.req.prompt),
+                "max_new_tokens": int(s.req.max_new_tokens),
+            } for s in self._sessions.values()],
+        }
+        return save_state(directory, name, state,
+                          meta={"kind": "decode_kv",
+                                "scheduler": self.name})
+
+    def _restore_kv(self, path):
+        from ..checkpoint import load_state
+        if self._sessions or self._pending:
+            raise RuntimeError(
+                "restore_kv into a busy scheduler (restore before "
+                "serving traffic)")
+        state = load_state(path)
+        geo = state["geometry"]
+        mine = {"max_batch": self.max_batch,
+                "block_size": self.block_size,
+                "max_prompt_len": self.max_prompt_len,
+                "max_new_tokens": self.max_new_tokens,
+                "num_blocks": self._pool.num_blocks}
+        if geo != mine:
+            raise ValueError("geometry mismatch: checkpoint %s vs "
+                             "scheduler %s" % (geo, mine))
+        jnp = self._jax.numpy
+        self._k_pools = self._jax.tree_util.tree_map(
+            jnp.asarray, state["k_pools"])
+        self._v_pools = self._jax.tree_util.tree_map(
+            jnp.asarray, state["v_pools"])
+        self._np_table[:] = state["table"]
+        self._np_lengths[:] = state["lengths"]
+        self._np_tokens[:] = state["tokens"]
+        self._pool._free = [int(b) for b in state["pool"]["free"]]
+        self._pool._live = set(int(b) for b in state["pool"]["live"])
+        futures = {}
+        for saved in state["sessions"]:
+            req = _Request(numpy.asarray(saved["prompt"], numpy.int32),
+                           saved["max_new_tokens"])
+            session = _Session(req, int(saved["row"]),
+                               [int(b) for b in saved["blocks"]])
+            session.length = int(saved["length"])
+            session.next_input = int(saved["next_input"])
+            session.generated = [int(t) for t in saved["generated"]]
+            session.first_token_s = saved["first_token_s"]
+            self._sessions[session.row] = session
+            with self._depth_lock:
+                self._depth += 1
+            futures[session.row] = req.future
+        self.metrics.set_occupancy(
+            len(self._sessions), self._pool.live_blocks /
+            max(self._pool.capacity, 1))
+        return futures
 
     # -- lifecycle / introspection -------------------------------------------
     def close(self, drain=True, timeout=30.0):

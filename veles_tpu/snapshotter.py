@@ -286,6 +286,11 @@ class SnapshotterBase(Unit, IResultProvider, Logger,
     mapping = "snapshotter"
     hide_from_registry = True
 
+    #: pickle backends gate the whole export to process 0; sharded
+    #: checkpoints (checkpoint/snapshot.py) flip this so EVERY process
+    #: exports — each writes only its own addressable shards
+    WRITES_ON_ALL_PROCESSES = False
+
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
@@ -438,10 +443,11 @@ class SnapshotterBase(Unit, IResultProvider, Logger,
             self._last_exported_best = self._decision_best()
         elif self.decision is not None:
             self.suffix = None
-        if not _writer_process():
+        if not (_writer_process() or self.WRITES_ON_ALL_PROCESSES):
             # multi-host: process 0 owns the (shared) filesystem; the
             # others keep identical throttle state but skip the write
-            # phase entirely instead of racing on it
+            # phase entirely instead of racing on it (sharded backends
+            # opt out — every process owns its own shards)
             return
         self._exporting_improvement_ = fresh
         t0 = time.perf_counter()
@@ -551,8 +557,20 @@ class SnapshotterToFile(SnapshotterBase):
         obs["written"].inc()
         events.span("snapshot.write", time.perf_counter() - t0,
                     snapshotter=self.prefix, path=path, bytes=size)
-        self._report_size(path, size, obj)
+        # gate BEFORE the diagnostic: _report_size re-pickles every
+        # unit, which doubles serialization work — only pay for it when
+        # the snapshot actually crossed the report threshold
+        threshold = self._size_threshold()
+        if threshold > 0 and size >= threshold:
+            self._report_size(path, size, obj)
         return path
+
+    def _size_threshold(self):
+        threshold = self.report_size_threshold
+        if threshold is None:
+            threshold = root.common.snapshot.get(
+                "report_size_threshold", 64 << 20)
+        return int(threshold)
 
     def _fsync_dir(self):
         try:
@@ -581,14 +599,9 @@ class SnapshotterToFile(SnapshotterBase):
     def _report_size(self, path, size, workflow, top=5):
         """Top-N fattest units diagnostic (reference snapshotter.py:
         203-226).  Runs on the writer thread in async mode — the
-        per-unit re-pickle never stalls the step loop."""
-        threshold = self.report_size_threshold
-        if threshold is None:
-            threshold = root.common.snapshot.get(
-                "report_size_threshold", 64 << 20)
-        threshold = int(threshold)
-        if threshold <= 0 or size < threshold:
-            return
+        per-unit re-pickle never stalls the step loop — and only when
+        the caller's threshold gate passed (SnapshotterToShards skips
+        this entirely: its manifest already measured every tensor)."""
         sizes = []
         for unit in workflow:
             try:
@@ -603,8 +616,13 @@ class SnapshotterToFile(SnapshotterBase):
     @staticmethod
     def import_file(path):
         """Load a snapshot back into a Workflow object (reference
-        snapshotter.py:522-535 + __main__.py:539)."""
+        snapshotter.py:522-535 + __main__.py:539).  Sharded checkpoint
+        directories (checkpoint/) route to their own importer, so the
+        launcher's ``--snapshot`` flag accepts either format."""
         path = os.path.realpath(os.path.expanduser(path))
+        if os.path.isdir(path):
+            from .checkpoint import import_dir
+            return import_dir(path)
         ext = os.path.splitext(path)[1]
         opener = DECODERS.get(ext, open)
         with opener(path, "rb") as f:
@@ -703,10 +721,16 @@ def restore(path):
     workflow; call .initialize(device=...) then .run().
 
     Sources (reference __main__.py:539-589 file/odbc/http): a snapshot
-    file path, ``sqlite://db.sqlite3[#prefix]``, or an ``http(s)://``
-    URL (fetched to a temp file first)."""
+    file path, a sharded checkpoint directory (or its snapshot root /
+    ``_current`` link / ``manifest.json``), ``sqlite://db.sqlite3
+    [#prefix]``, or an ``http(s)://`` URL (fetched to a temp file
+    first)."""
     if path.startswith("sqlite://"):
         return SnapshotterToDB.import_db(path)
+    real = os.path.realpath(os.path.expanduser(path))
+    if os.path.isdir(real) or os.path.basename(real) == "manifest.json":
+        from .checkpoint import import_dir
+        return import_dir(path)
     if path.startswith(("http://", "https://")):
         import tempfile
         import urllib.request

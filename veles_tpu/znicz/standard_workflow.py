@@ -220,9 +220,19 @@ class StandardWorkflow(Workflow):
             self.gds.append(gd)
 
         if self.snapshotter_config is not None:
-            from ..snapshotter import SnapshotterToFile
-            self.snapshotter = SnapshotterToFile(
-                self, **self.snapshotter_config)
+            cfg = dict(self.snapshotter_config)
+            fmt = cfg.pop("format", None)
+            if fmt is None:
+                from ..config import root
+                fmt = root.common.snapshot.get("format", "pickle")
+            if fmt in ("shards", "sharded"):
+                from ..checkpoint import SnapshotterToShards as snap_cls
+            elif fmt in ("pickle", "file", None):
+                from ..snapshotter import SnapshotterToFile as snap_cls
+            else:
+                from ..registry import MappedObjectsRegistry
+                snap_cls = MappedObjectsRegistry.get("snapshotter", fmt)
+            self.snapshotter = snap_cls(self, **cfg)
             self.snapshotter.link_decision(self.decision)
             # snapshot the moment validation improves — BEFORE the next
             # train pass mutates the weights — so a restored
@@ -362,7 +372,13 @@ class StandardWorkflow(Workflow):
     def initialize(self, device=None, **kwargs):
         if isinstance(self.mesh, dict):   # restored from a snapshot
             from ..parallel import mesh as mesh_mod
-            self.mesh = mesh_mod.make_mesh(self.mesh)
+            self.mesh = mesh_mod.mesh_for_spec(self.mesh)
+        # cross-mesh restore: the workflow's mesh (spec-rebuilt above,
+        # or a Mesh the caller assigned before initialize) overrides the
+        # geometry the sharded step snapshotted for itself
+        step = getattr(self, "fused_step", None)
+        if self.mesh is not None and getattr(step, "mesh", None) is not None:
+            step.mesh = self.mesh
         if self.restored_from_snapshot:
             self._relink_gates()
         result = super().initialize(device=device, **kwargs)
