@@ -810,6 +810,45 @@ def bench_fleet(replicas=3, probe_timeout=360):
     return {k: line.get(k) for k in keys}
 
 
+def bench_chaos(replicas=3, probe_timeout=400):
+    """Seeded chaos drill on the real-package fleet (ISSUE 12
+    acceptance: SIGKILL + black-hole + truncation + SIGSTOP under a
+    deadline-carrying open loop with ZERO failed non-backpressure,
+    non-504 responses, bounded kill recovery).  One fresh subprocess
+    (``tools/serve_bench.py --chaos N``) owns the router and the
+    fault-injected replica grandchildren, so a wedged drill dies with
+    the stage instead of leaking."""
+    import subprocess
+    import tempfile
+    _stamp("chaos stage")
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "serve_bench.py")
+    cache_dir = os.path.join(
+        tempfile.mkdtemp(prefix="veles-chaos-bench-"), "compile_cache")
+    argv = [sys.executable, tool, "--chaos", str(replicas),
+            "--json", "--cache-dir", cache_dir]
+    proc = subprocess.run(argv, capture_output=True,
+                          timeout=probe_timeout)
+    line = _last_json_line(proc.stdout.decode())
+    if line is None:
+        raise RuntimeError("chaos probe failed: %s"
+                           % proc.stderr.decode()[-400:])
+    _stamp("chaos: ok=%s shed=%s expired=%s failed=%s, kill recovery "
+           "%ss, %s truncated / %s retried / %s breaker trips"
+           % (line.get("chaos_ok"), line.get("chaos_shed"),
+              line.get("chaos_expired"), line.get("chaos_failed"),
+              line.get("chaos_kill_recovery_s"),
+              line.get("chaos_truncated"), line.get("chaos_retries"),
+              line.get("chaos_breaker_trips")))
+    keys = ("chaos_replicas", "chaos_offered_rps", "chaos_seconds",
+            "chaos_start_s", "chaos_ok", "chaos_shed", "chaos_expired",
+            "chaos_failed", "chaos_p99_ms", "chaos_kill_recovery_s",
+            "chaos_truncated", "chaos_aborted", "chaos_retries",
+            "chaos_breaker_trips", "chaos_restarts",
+            "chaos_ready_after")
+    return {k: line.get(k) for k in keys}
+
+
 def bench_graph_compile(probe_timeout=150):
     """Whole-workflow compilation (ISSUE 8 acceptance: a non-standard
     two-branch workflow traced >= 1.5x its interpreted throughput, the
@@ -1208,6 +1247,8 @@ def _stage_main(stage):
         out = bench_decode()
     elif stage == "fleet":
         out = bench_fleet()
+    elif stage == "chaos":
+        out = bench_chaos()
     elif stage == "graph_compile":
         out = bench_graph_compile()
     else:
@@ -1272,6 +1313,12 @@ STAGE_PLAN = [
     # and rolling-update error rate (ISSUE 7) — one fresh subprocess
     # owning router + N replica grandchildren under a hard cap
     ("fleet", 420),
+    # seeded chaos drill (ISSUE 12): scripted SIGKILL / black-hole /
+    # truncation / SIGSTOP against the real-package fleet under a
+    # deadline-carrying open loop — zero failed (non-backpressure,
+    # non-504) responses and the kill-recovery seconds; one fresh
+    # subprocess owning the fault-injected replica grandchildren
+    ("chaos", 420),
     # whole-workflow compilation (ISSUE 8): the non-standard two-branch
     # DAG interpreted vs traced (>= 1.5x acceptance), the standard MNIST
     # topology traced vs hand-fused (no-regression proof), and the

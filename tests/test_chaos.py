@@ -1,0 +1,426 @@
+"""Deterministic fault injection + failover guarantees (ISSUE 12).
+
+The contract under test:
+- a FaultPlan is reproducible: same seed + same request sequence →
+  the same faults fire at the same ordinals, and only on data-plane
+  paths (the harness's own health/admin traffic stays clean);
+- exactly-once through truncation: a buffered response cut mid-body
+  retries safely on a peer (no client byte was written); a STREAMED
+  response cut mid-body aborts the client connection — the client
+  sees a transport error, never a silent double-send;
+- the circuit breaker walks closed → open after consecutive
+  connection failures, re-admits through half-open on answered health
+  polls, and re-opens instantly on a half-open failure;
+- a hung (SIGSTOP) replica is marked down by the health poll, its
+  in-flight requests fail over within the timeout budget, and SIGCONT
+  re-admits it through the breaker's half-open probe;
+- a seeded chaos drill (SIGKILL + black-hole + truncation) over a
+  deadline-carrying open loop finishes with ZERO failed
+  (non-backpressure, non-deadline) responses.
+"""
+
+import http.client
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from veles_tpu.fleet import FaultPlan, Fleet
+from veles_tpu.fleet.chaos import _TruncatingFile
+
+
+# -- FaultPlan unit -----------------------------------------------------------
+
+class _FakeHandler:
+    def __init__(self, path):
+        self.path = path
+        self.close_connection = False
+
+
+def _fired(plan, paths):
+    """Drive a plan over a request sequence; yields, per request,
+    (connection_was_refused, handler_actually_ran)."""
+    out = []
+    for path in paths:
+        handler = _FakeHandler(path)
+        hit = []
+        plan.apply(handler, lambda h, _hit=hit: _hit.append("ran"))
+        out.append((handler.close_connection, hit == ["ran"]))
+    return out
+
+
+def test_fault_plan_ordinals_and_exemptions():
+    plan = FaultPlan([{"at": 2, "action": "refuse"},
+                      {"after": 4, "action": "refuse"}])
+    paths = ["/api/m", "/healthz", "/api/m", "/metrics", "/api/m",
+             "/admin/sessions/export", "/api/m", "/api/m"]
+    results = _fired(plan, paths)
+    # control-plane requests neither count against ordinals nor fault;
+    # data ordinals here are 1,2,3,4,5 at indices 0,2,4,6,7
+    refused = [i for i, (closed, _) in enumerate(results) if closed]
+    assert refused == [2, 6, 7]
+    assert all(ran for i, (_, ran) in enumerate(results)
+               if i not in refused)
+    assert all(not ran for i, (_, ran) in enumerate(results)
+               if i in refused)
+    assert plan.fired == [(2, "refuse"), (4, "refuse"), (5, "refuse")]
+
+
+def test_fault_plan_seed_reproducible():
+    rules = [{"probability": 0.5, "action": "refuse"}]
+    runs = []
+    for _ in range(2):
+        plan = FaultPlan(rules, seed=42)
+        runs.append([closed for closed, _ in
+                     _fired(plan, ["/api/m"] * 32)])
+    assert runs[0] == runs[1]
+    assert any(runs[0]) and not all(runs[0])
+    different = [closed for closed, _ in
+                 _fired(FaultPlan(rules, seed=43), ["/api/m"] * 32)]
+    assert different != runs[0]
+
+
+def test_fault_plan_env_roundtrip():
+    plan = FaultPlan([{"at": 3, "action": "truncate", "bytes": 16}],
+                     seed=9)
+    env = plan.env({})
+    clone = FaultPlan.from_json(env["VELES_FAULT_PLAN"])
+    assert clone.seed == 9 and clone.rules == plan.rules
+
+
+def test_fault_plan_rejects_unknown_action():
+    with pytest.raises(ValueError):
+        FaultPlan([{"at": 1, "action": "meteor"}])
+
+
+class _Sink:
+    def __init__(self):
+        self.data = b""
+
+    def write(self, b):
+        self.data += b
+        return len(b)
+
+    def flush(self):
+        pass
+
+
+def test_truncating_file_cuts_body_not_headers():
+    sink = _Sink()
+    f = _TruncatingFile(sink, 4)
+    f.write(b"HTTP/1.1 200 OK\r\nContent-Length: 10\r\n\r\n")
+    f.write(b"0123456789")
+    assert sink.data.endswith(b"\r\n\r\n0123")
+    assert f.truncated
+    assert b"Content-Length: 10" in sink.data   # headers intact
+
+
+# -- fleet helpers ------------------------------------------------------------
+
+def _post(url, payload, headers=None, timeout=60):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        {"Content-Type": "application/json", **(headers or {})})
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _sleep_fleet(replicas=2, fault_plans=None, request_timeout=5,
+                 row_sleep="0.005", dim=4):
+    return Fleet({"m": "sleep:%s:%d" % (row_sleep, dim)},
+                 replicas=replicas, poll_interval=0.1,
+                 request_timeout=request_timeout,
+                 fault_plans=fault_plans,
+                 backoff={"base": 0.1, "factor": 2.0, "cap": 2.0,
+                          "max_restarts": 10}).start(ready_timeout=120)
+
+
+def _rep_counters(router, rid):
+    """Snapshot one replica's router-side counters (the metrics
+    registry is process-global and label-keyed, so tests assert on
+    DELTAS, never absolutes)."""
+    met = router.merged_metrics()["router"]["replicas"][rid]
+    return {k: met[k] for k in
+            ("truncated", "aborted", "retries", "breaker_trips")}
+
+
+def _delta(router, rid, before):
+    now = _rep_counters(router, rid)
+    return {k: now[k] - before[k] for k in before}
+
+
+def _wait(predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError("timed out waiting for " + what)
+
+
+# -- exactly-once through truncation ------------------------------------------
+
+def test_truncated_buffered_response_retries_exactly_once():
+    """A small (buffered) body cut mid-flight: the router had written
+    nothing to the client yet, so the request retries on the peer and
+    the client sees one clean 200."""
+    fleet = _sleep_fleet(fault_plans={
+        "r0": {"rules": [{"at": 1, "action": "truncate", "bytes": 8}]}})
+    try:
+        before = _rep_counters(fleet.router, "r0")
+        statuses = [
+            _post(fleet.url + "/api/m", {"input": [[1, 2, 3, 4]]})[0]
+            for _ in range(6)]
+        assert statuses == [200] * 6, statuses
+        moved = _delta(fleet.router, "r0", before)
+        assert moved["truncated"] == 1, moved
+        assert moved["retries"] == 1, moved
+        assert moved["aborted"] == 0, moved
+    finally:
+        fleet.stop()
+
+
+def test_truncated_streamed_response_aborts_not_doublesends():
+    """A body past stream_threshold is streamed; cut mid-stream the
+    router closes the client connection instead of retrying — the
+    client observes a transport error (or an unreadable body), never
+    two answers."""
+    fleet = _sleep_fleet(
+        fault_plans={"r0": {"rules": [{"after": 1, "action": "truncate",
+                                       "bytes": 1000}]}},
+        row_sleep="0.0001", dim=2048)
+    try:
+        fleet.router.set_admitting("r1", False)
+        before = _rep_counters(fleet.router, "r0")
+        # 16 × 2048 floats echo back well past the 64 KiB threshold
+        payload = {"input": [[1.0] * 2048] * 16}
+        with pytest.raises((urllib.error.URLError, OSError,
+                            http.client.HTTPException,
+                            json.JSONDecodeError)):
+            req = urllib.request.Request(
+                fleet.url + "/api/m", json.dumps(payload).encode(),
+                {"Content-Type": "application/json"})
+            resp = urllib.request.urlopen(req, timeout=30)
+            json.loads(resp.read())     # body cut short of its length
+        moved = _delta(fleet.router, "r0", before)
+        assert moved["aborted"] == 1, moved
+        assert moved["retries"] == 0, moved
+    finally:
+        fleet.router.set_admitting("r1", True)
+        fleet.stop()
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+def test_breaker_state_machine():
+    """closed → open after threshold consecutive failures, half-open
+    after cooldown + an answered poll, closed on the next answered
+    poll; a half-open failure re-opens instantly; open/half-open
+    excludes the replica from dispatch (prefer included)."""
+    from veles_tpu.fleet.router import FleetRouter, _Replica
+    router = FleetRouter(poll_interval=60, breaker_threshold=3,
+                         breaker_cooldown=0.1)
+    try:
+        rep = _Replica("x", "127.0.0.1", 1)
+        with router._lock:
+            router._replicas["x"] = rep
+        for _ in range(2):
+            router._note_failure(rep)
+        assert rep.breaker == "closed"
+        router._note_failure(rep)
+        assert rep.breaker == "open"
+        router._breaker_probe(rep)             # cooldown not elapsed
+        assert rep.breaker == "open"
+        time.sleep(0.12)
+        router._breaker_probe(rep)
+        assert rep.breaker == "half_open"
+        router._note_failure(rep)              # half-open failure
+        assert rep.breaker == "open"
+        time.sleep(0.12)
+        router._breaker_probe(rep)
+        router._breaker_probe(rep)
+        assert rep.breaker == "closed" and rep.fail_streak == 0
+        rep.up = rep.ready = True
+        assert router.pick() is rep
+        with router._lock:
+            rep.inflight -= 1
+            rep.breaker = "open"
+        assert router.pick() is None
+        assert router.pick(prefer="x") is None
+    finally:
+        router.stop()
+
+
+def test_breaker_trips_on_refusing_data_plane():
+    """A replica whose data plane refuses every request while its
+    health endpoint stays green is what the breaker exists for: after
+    the streak threshold the router stops offering it traffic."""
+    fleet = _sleep_fleet(fault_plans={
+        "r0": {"rules": [{"after": 1, "action": "refuse"}]}})
+    try:
+        before = _rep_counters(fleet.router, "r0")
+        for _ in range(12):
+            status, _, _ = _post(fleet.url + "/api/m",
+                                 {"input": [[1, 2, 3, 4]]})
+            assert status == 200          # always answered via r1
+            if fleet.router.replica("r0").breaker == "open":
+                break
+            time.sleep(0.15)              # let the poll revive r0
+        moved = _delta(fleet.router, "r0", before)
+        assert moved["breaker_trips"] >= 1, moved
+    finally:
+        fleet.stop()
+
+
+# -- hung replica (SIGSTOP) ---------------------------------------------------
+
+def test_sigstop_hung_replica_fails_over_and_readmits():
+    """SIGSTOP freezes a replica without killing it (the listen
+    backlog still accepts; nothing answers): the health poll marks it
+    down, in-flight requests time out and fail over to the peer
+    within the request-timeout budget, and SIGCONT brings it back
+    through the breaker's half-open poll path — no respawn."""
+    fleet = _sleep_fleet(request_timeout=2)
+    router = fleet.router
+    victim = "r0"
+    pid = fleet.supervisor._replicas[victim].pid
+    try:
+        router.set_admitting("r1", False)      # pin dispatch to victim
+        os.kill(pid, signal.SIGSTOP)
+        results = []
+
+        def fire():
+            results.append(_post(fleet.url + "/api/m",
+                                 {"input": [[1, 2, 3, 4]]},
+                                 timeout=30)[0])
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=fire) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)                        # in flight, frozen
+        router.set_admitting("r1", True)       # failover destination
+        _wait(lambda: not router.replica(victim).up,
+              timeout=3.0, what="poll to mark hung replica down")
+        for t in threads:
+            t.join(30)
+        elapsed = time.perf_counter() - t0
+        assert results == [200] * 3, results
+        # bounded by request_timeout + retry, not a 60 s default
+        assert elapsed < 10, elapsed
+        # three concurrent timeouts = three consecutive connection
+        # failures: the breaker tripped
+        assert router.replica(victim).breaker == "open"
+        os.kill(pid, signal.SIGCONT)
+        _wait(lambda: (router.replica(victim).up
+                       and router.replica(victim).breaker == "closed"),
+              timeout=10.0, what="SIGCONT re-admission via half-open")
+        assert fleet.supervisor.describe()[victim]["restarts"] == 0
+    finally:
+        try:
+            os.kill(pid, signal.SIGCONT)
+        except (OSError, TypeError):
+            pass
+        router.set_admitting("r1", True)
+        fleet.stop()
+
+
+# -- the seeded drill ---------------------------------------------------------
+
+def test_seeded_chaos_drill_zero_unexplained_failures():
+    """SIGKILL + black-hole + truncation against an open loop carrying
+    deadlines: every response is 200, backpressure (429/503 with
+    client retry) or a deadline 504 — never a raw failure — and the
+    killed replica respawns with its restart budget visible in the
+    merged metrics."""
+    plans = {
+        "r0": {"seed": 1, "rules": [{"at": 10, "action": "sigkill"}]},
+        "r1": {"seed": 2, "rules": [{"every": 9, "action": "truncate",
+                                     "bytes": 20}]},
+        "r2": {"seed": 3, "rules": [{"at": 7, "action": "blackhole",
+                                     "seconds": 1.5}]},
+    }
+    fleet = _sleep_fleet(replicas=3, fault_plans=plans,
+                         request_timeout=4)
+    counts = {"ok": 0, "shed": 0, "expired": 0, "failed": 0}
+    lock = threading.Lock()
+    stop = time.perf_counter() + 6.0
+
+    def client():
+        while time.perf_counter() < stop:
+            status = -1
+            for _ in range(10):     # a well-behaved client retries 503
+                try:
+                    status, _, _ = _post(
+                        fleet.url + "/api/m",
+                        {"input": [[1, 2, 3, 4]]},
+                        headers={"X-Deadline-Ms": "8000"}, timeout=30)
+                except Exception:
+                    status = -1
+                if status != 503:
+                    break
+                time.sleep(0.1)
+            with lock:
+                if status == 200:
+                    counts["ok"] += 1
+                elif status in (429, 503):
+                    counts["shed"] += 1
+                elif status == 504:
+                    counts["expired"] += 1
+                else:
+                    counts["failed"] += 1
+    try:
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert counts["failed"] == 0, counts
+        assert counts["ok"] > 20, counts
+        _wait(lambda: fleet.router.ready_count() == 3, timeout=60,
+              what="killed replica to respawn ready")
+        desc = fleet.supervisor.describe()
+        assert desc["r0"]["restarts"] >= 1, desc
+        # the restart budget rides the one merged /metrics payload
+        sup = fleet.router.merged_metrics()["supervisor"]
+        assert sup["r0"]["restarts_remaining"] <= 9, sup
+        assert sup["r1"]["failed"] is False
+    finally:
+        fleet.stop()
+
+
+def test_deadline_expired_in_server_queue_returns_504():
+    """X-Deadline-Ms flows router → replica → scheduler: a request
+    whose budget is smaller than the queue ahead of it answers 504
+    without occupying a batch row, while the queued work completes.
+    One replica, so the blockers deterministically occupy the worker
+    the tight-budget request queues behind."""
+    fleet = _sleep_fleet(replicas=1, row_sleep="0.05",
+                         request_timeout=10)
+    try:
+        blockers = []
+
+        def block():
+            blockers.append(_post(fleet.url + "/api/m",
+                                  {"input": [[1.0] * 4] * 20},
+                                  timeout=60)[0])
+        threads = [threading.Thread(target=block) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        status, body, _ = _post(fleet.url + "/api/m",
+                                {"input": [[1, 2, 3, 4]]},
+                                headers={"X-Deadline-Ms": "120"})
+        for t in threads:
+            t.join(60)
+        assert status == 504, (status, body)
+        assert blockers.count(200) == 2, blockers
+    finally:
+        fleet.stop()

@@ -213,6 +213,28 @@ def test_fleet_merged_endpoints(sleep_fleet):
     assert {key[0] for key in up.children()} >= {"r0", "r1", "r2"}
 
 
+def test_supervisor_restart_budget_in_metrics(sleep_fleet):
+    """The supervisor's restart budget and crash-looper state ride the
+    one merged /metrics payload operators already poll — no separate
+    endpoint to discover after a replica starts flapping."""
+    status, met = _get(sleep_fleet.url + "/metrics")
+    assert status == 200
+    sup = met["supervisor"]
+    assert set(sup) == {"r0", "r1", "r2"}
+    for rid, view in sup.items():
+        assert view["state"] == "up", (rid, view)
+        assert view["failed"] is False
+        # backoff policy: max_restarts=10, so the remaining budget is
+        # 10 minus whatever earlier tests in this module burned
+        assert 0 <= view["restarts_remaining"] <= 10
+        assert view["restarts_remaining"] == 10 - view["restarts"]
+        assert view["crash_streak"] >= 0
+    # describe() is the same source of truth, router-wiring aside
+    desc = sleep_fleet.supervisor.describe()
+    for rid, view in desc.items():
+        assert view["restarts_remaining"] == sup[rid]["restarts_remaining"]
+
+
 def test_fleet_trace_one_id_router_to_batch(sleep_fleet, tmp_path):
     """One trace id spans router -> replica request -> serving.batch
     (the replicas trace via VELES_TRACE_DIR; the in-process router via

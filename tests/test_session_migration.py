@@ -1,0 +1,318 @@
+"""Session-preserving failover: live KV migration + deadlines (ISSUE 12).
+
+The contract under test (acceptance):
+- a mid-generation session exported from one DecodeScheduler and
+  imported into a peer continues with BITWISE-identical tokens — the
+  per-session KV checkpoint (blocks, sampler inputs, emitted tokens)
+  is the complete generation state;
+- export PARKS the client's future (nothing answered) until
+  release_migrated confirms the import — a failed import restores the
+  session at the source, so migration can degrade to "nothing moved"
+  but never to a lost or doubled answer;
+- idle sessions spill to a host-side sharded checkpoint and re-admit
+  later, same tokens;
+- deadlines shed work at every pre-device stage: an expired submit
+  never enqueues, an expired queued request never takes a batch row;
+- a rolling update over a fleet with LIVE sessions migrates them to a
+  peer (router follows the 307), finishes every generation bitwise
+  and drains bounded by migration, not generation length.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.serving import (DeadlineExpired, DecodeScheduler,
+                               ToyDecodeModel)
+from veles_tpu.serving.sessions import pack_states, unpack_states
+from veles_tpu.znicz.samples.flagship import (FlagshipDecodeModel,
+                                              generate_reference)
+
+GEOM = dict(max_batch=4, block_size=4, max_prompt_len=8,
+            max_new_tokens=8)
+
+
+@pytest.fixture(scope="module")
+def model():
+    m = FlagshipDecodeModel(stages=2, experts=2, d=16, heads=2,
+                            hidden=32, vocab=32, seed=0)
+    # pin per-step wall time host-side so exports reliably catch
+    # sessions MID-generation (the DecodeScheduler._step hook)
+    m.step_host_delay = 0.02
+    return m
+
+
+@pytest.fixture(scope="module")
+def oracle(model):
+    memo = {}
+
+    def run(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in memo:
+            memo[key] = generate_reference(model.params, prompt, n)
+        return memo[key]
+    return run
+
+
+@pytest.fixture()
+def pair(model):
+    """A source/target scheduler pair over the same weights."""
+    a = DecodeScheduler(model, name="miga", **GEOM)
+    b = DecodeScheduler(model, name="migb", **GEOM)
+    yield a, b
+    a.close(drain=True)
+    b.close(drain=True)
+
+
+# -- in-process migration -----------------------------------------------------
+
+def test_live_migration_bitwise(pair, oracle):
+    """Sessions exported mid-generation and imported on a peer finish
+    with exactly the tokens an uninterrupted run emits — through the
+    wire encoding (base64 numpy framing), as between real replicas."""
+    a, b = pair
+    rng = numpy.random.RandomState(7)
+    requests = [(rng.randint(0, 32, rng.randint(1, 9)).tolist(), 8)
+                for _ in range(4)]
+    futures = [a.submit(p, n, session_id="s%d" % i)
+               for i, (p, n) in enumerate(requests)]
+    time.sleep(0.1)                    # a few steps into each
+    states = a.export_sessions()
+    assert states, "export caught no live sessions"
+    exported = {s["session_id"] for s in states}
+    # the source answers NOTHING until the import is confirmed
+    assert all(not f.done() for i, f in enumerate(futures)
+               if "s%d" % i in exported)
+    done, errors = b.import_sessions(unpack_states(pack_states(states)))
+    assert errors == [] and set(done) == exported
+    released = a.release_migrated(done, target="127.0.0.1:1234")
+    assert set(released) == exported
+    for i, ((prompt, n), future) in enumerate(zip(requests, futures)):
+        sid = "s%d" % i
+        if sid not in exported:        # finished before the export
+            assert future.result(60)["tokens"] == oracle(prompt, n)
+            continue
+        marker = future.result(10)
+        assert marker["migrated"] and marker["target"] == "127.0.0.1:1234"
+        kind, val = b.attach(sid)
+        result = val if kind == "finished" else val.result(60)
+        assert result["tokens"] == oracle(prompt, n), sid
+        assert result["session_id"] == sid
+    assert a.stats()["migrating_sessions"] == 0
+    assert a.stats()["active_sequences"] == 0
+
+
+def test_failed_import_restores_source(pair, oracle):
+    """A target that rejects a session (duplicate id here) leaves it
+    re-importable at the source — the parked future is reused and the
+    client still gets the full answer."""
+    a, b = pair
+    fut = a.submit([1, 2, 3], 8, session_id="dup")
+    b.submit([9, 9], 8, session_id="dup")          # occupies the sid
+    time.sleep(0.06)
+    states = a.export_sessions(["dup"])
+    assert len(states) == 1
+    done, errors = b.import_sessions(states)
+    assert done == [] and len(errors) == 1 and errors[0][0] == "dup"
+    # restore: re-import at the source; the parked future is reused
+    rdone, rerrors = a.import_sessions(states)
+    assert rdone == ["dup"] and rerrors == []
+    assert fut.result(60)["tokens"] == oracle([1, 2, 3], 8)
+
+
+def test_pending_requests_migrate_as_prompt_only(pair, oracle):
+    """Queued-but-unprefilled requests ride along as prompt-only
+    states: the peer prefills them from scratch, same tokens."""
+    a, b = pair
+    # fill the batch so the 5th request stays queued
+    futures = [a.submit([i + 1], 8) for i in range(GEOM["max_batch"])]
+    queued = a.submit([7, 7, 7], 4, session_id="queued")
+    states = a.export_sessions()
+    assert "queued" in {s["session_id"] for s in states}
+    done, errors = b.import_sessions(unpack_states(pack_states(states)))
+    assert errors == []
+    a.release_migrated(done, target="peer:1")
+    assert queued.result(10)["migrated"]
+    kind, val = b.attach("queued")
+    result = val if kind == "finished" else val.result(60)
+    assert result["tokens"] == oracle([7, 7, 7], 4)
+    for f in futures:
+        r = f.result(60)
+        assert r.get("migrated") or len(r["tokens"]) == 8
+
+
+def test_spill_and_readmit_roundtrip(tmp_path, oracle, model):
+    """An idle session spills to a host checkpoint (freeing its row
+    and blocks) and re-admits later with identical continuation."""
+    s = DecodeScheduler(model, name="spill", **GEOM)
+    try:
+        fut = s.submit([3, 1, 4, 1, 5], 8, session_id="cold")
+        time.sleep(0.08)
+        path = s.spill_session("cold", str(tmp_path))
+        marker = fut.result(10)
+        assert marker["spilled"] and marker["path"] == path
+        assert s.stats()["active_sequences"] == 0
+        sid = s.readmit_session(path)
+        assert sid == "cold"
+        kind, val = s.attach("cold")
+        result = val if kind == "finished" else val.result(60)
+        assert result["tokens"] == oracle([3, 1, 4, 1, 5], 8)
+        # delete=True cleared the checkpoint after re-admit
+        import os
+        assert not os.path.exists(path)
+    finally:
+        s.close(drain=True)
+
+
+def test_toydecode_matches_its_oracle():
+    """The fleet drill stand-in: device decode through the paged cache
+    equals the pure-python host oracle (the cross-process token
+    identity the subprocess drills rely on)."""
+    m = ToyDecodeModel(vocab=53)
+    s = DecodeScheduler(m, name="toysched", **GEOM)
+    try:
+        rng = numpy.random.RandomState(3)
+        for _ in range(5):
+            prompt = rng.randint(0, 53, rng.randint(1, 9)).tolist()
+            n = int(rng.randint(1, 9))
+            assert s.submit(prompt, n).result(60)["tokens"] == \
+                m.generate_reference(prompt, n)
+    finally:
+        s.close(drain=True)
+
+
+# -- deadlines ----------------------------------------------------------------
+
+def test_decode_expired_submit_never_enqueues(model):
+    s = DecodeScheduler(model, name="dl1", **GEOM)
+    try:
+        with pytest.raises(DeadlineExpired):
+            s.submit([1, 2], 4, deadline=time.monotonic() - 0.001)
+        assert s.stats()["queue_depth"] == 0
+        assert s.metrics.snapshot()["expired"] == 1
+    finally:
+        s.close(drain=True)
+
+
+def test_decode_queued_request_sheds_without_batch_row(model, oracle):
+    """A request whose deadline passes IN the queue is failed at admit
+    time — it never occupies a batch row or allocates KV blocks."""
+    s = DecodeScheduler(model, name="dl2", **GEOM)
+    try:
+        # saturate the batch with long generations
+        long = [s.submit([i + 1], 8) for i in range(GEOM["max_batch"])]
+        doomed = s.submit([5, 5], 8,
+                          deadline=time.monotonic() + 0.03)
+        with pytest.raises(DeadlineExpired):
+            doomed.result(30)
+        assert s.metrics.snapshot()["expired"] == 1
+        for i, f in enumerate(long):
+            assert f.result(60)["tokens"] == oracle([i + 1], 8)
+    finally:
+        s.close(drain=True)
+
+
+def test_bucket_scheduler_deadline():
+    from veles_tpu.serving import BucketScheduler
+    s = BucketScheduler(lambda x: x, name="bucketdl", max_batch=4,
+                        sample_shape=(2,))
+    try:
+        with pytest.raises(DeadlineExpired):
+            s.infer(numpy.ones((1, 2)),
+                    deadline=time.monotonic() - 0.001)
+        assert s.metrics.snapshot()["expired"] == 1
+    finally:
+        s.close(drain=True)
+
+
+# -- fleet: rolling update with live sessions ---------------------------------
+
+def _post(url, payload, headers=None, timeout=90):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        {"Content-Type": "application/json", **(headers or {})})
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+TOY_SPEC = ("toydecode:vocab=97,delay=0.06,max_batch=4,block=4,"
+            "max_prompt=8,max_new=32")
+
+
+def test_fleet_rolling_update_migrates_live_sessions():
+    """Rolling update while sessions are mid-generation: every client
+    gets the bitwise-uninterrupted sequence (the router follows the
+    source's 307 to the session's new home), zero failures, and each
+    replica's quiesce is bounded by migration time — NOT by the ~1.9 s
+    the longest generation still had to run."""
+    from veles_tpu.fleet import Fleet
+    oracle = ToyDecodeModel(vocab=97).generate_reference
+    fleet = Fleet({"toy": TOY_SPEC}, replicas=2, poll_interval=0.1,
+                  request_timeout=30,
+                  backoff={"base": 0.1, "max_restarts": 5})
+    fleet.start(ready_timeout=120)
+    try:
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+        results = [None] * len(prompts)
+
+        def fire(i):
+            results[i] = _post(
+                fleet.url + "/api/toy/generate",
+                {"prompt": prompts[i], "max_new_tokens": 32,
+                 "session_id": "roll%d" % i})
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(len(prompts))]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(0.4)                # all mid-generation (32 steps
+                                       # x 60 ms ≈ 1.9 s to go)
+        update = fleet.rolling_update("toy", TOY_SPEC, version="v2")
+        for t in threads:
+            t.join(90)
+        elapsed = time.perf_counter() - t0
+        assert update["updated"] == ["r0", "r1"]
+        for i, (status, body, _) in enumerate(results):
+            assert status == 200, (i, results[i])
+            assert body["tokens"] == oracle(prompts[i], 32), i
+        # the sessions crossed replicas at least once
+        met = fleet.router.merged_metrics()
+        assert met["router"]["session_follows"] >= 1, met["router"]
+        assert elapsed < 60, elapsed
+    finally:
+        fleet.stop()
+
+
+def test_fleet_session_affinity_follow_up():
+    """A finished session's result is re-fetchable by id through the
+    router (affinity pins the follow-up to the owning replica)."""
+    from veles_tpu.fleet import Fleet
+    oracle = ToyDecodeModel(vocab=97).generate_reference
+    fleet = Fleet({"toy": TOY_SPEC.replace("delay=0.06", "delay=0.0")},
+                  replicas=2, poll_interval=0.1, request_timeout=30,
+                  backoff={"base": 0.1, "max_restarts": 5})
+    fleet.start(ready_timeout=120)
+    try:
+        status, body, _ = _post(
+            fleet.url + "/api/toy/generate",
+            {"prompt": [2, 4, 6], "max_new_tokens": 8,
+             "session_id": "aff1"})
+        assert status == 200
+        expect = oracle([2, 4, 6], 8)
+        assert body["tokens"] == expect
+        # same id again: attach to the finished result, not a re-run
+        status, again, _ = _post(
+            fleet.url + "/api/toy/generate",
+            {"prompt": [2, 4, 6], "max_new_tokens": 8},
+            headers={"X-Session-Id": "aff1"})
+        assert status == 200 and again["tokens"] == expect
+    finally:
+        fleet.stop()

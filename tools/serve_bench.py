@@ -500,24 +500,29 @@ def run_decode_bench(seconds=2.0, n_requests=None, max_batch=8,
 
 
 def _http_status_open_loop(port, offered_rps, seconds, sizes,
-                           sample_shape, route="/api/mnist"):
-    """Paced open loop that records STATUS CLASSES: (ok, shed_429,
-    failed) — the fleet drills need "non-429 failures == 0", which the
-    closed-loop helper's single error bucket cannot express."""
+                           sample_shape, route="/api/mnist",
+                           headers=None, shed_statuses=(429,)):
+    """Paced open loop that records STATUS CLASSES: (ok, shed,
+    expired_504, failed) — the fleet drills need "non-backpressure
+    failures == 0", which the closed-loop helper's single error bucket
+    cannot express.  ``headers`` rides on every request (the chaos
+    drill sends ``X-Deadline-Ms``); ``shed_statuses`` says which codes
+    count as backpressure rather than failure."""
     bodies = {bs: json.dumps({"input": numpy.random.RandomState(bs)
                               .uniform(-1, 1, (bs,) + tuple(sample_shape))
                               .round(4).tolist()}).encode()
               for bs in sizes}
+    req_headers = {"Content-Type": "application/json", **(headers or {})}
     lock = threading.Lock()
-    out = {"ok": 0, "shed": 0, "failed": 0, "latencies": []}
+    out = {"ok": 0, "shed": 0, "expired": 0, "failed": 0,
+           "latencies": []}
 
     def fire(body):
         t0 = time.perf_counter()
         try:
             conn = http.client.HTTPConnection("127.0.0.1", port,
                                               timeout=30)
-            conn.request("POST", route, body,
-                         {"Content-Type": "application/json"})
+            conn.request("POST", route, body, req_headers)
             status = conn.getresponse()
             status.read()
             code = status.status
@@ -528,8 +533,10 @@ def _http_status_open_loop(port, offered_rps, seconds, sizes,
             if code == 200:
                 out["ok"] += 1
                 out["latencies"].append(time.perf_counter() - t0)
-            elif code == 429:
+            elif code in shed_statuses:
                 out["shed"] += 1
+            elif code == 504:
+                out["expired"] += 1
             else:
                 out["failed"] += 1
 
@@ -697,6 +704,109 @@ def run_fleet_bench(replicas=3, clients=None, seconds=2.0,
     return out
 
 
+def run_chaos_bench(replicas=3, package=None, offered_rps=40.0,
+                    drill_seconds=10.0, sizes=DEFAULT_SIZES,
+                    max_batch=16, cache_dir=None):
+    """The seeded chaos drill (ISSUE 12 acceptance) against the REAL
+    exported package: a deterministic FaultPlan per replica — SIGKILL,
+    response truncation, connection black-hole, SIGSTOP freeze — under
+    a deadline-carrying open loop.  The bar: ``chaos_failed == 0``
+    (every response is 200, backpressure, or a deadline 504), plus the
+    kill→ready-again recovery seconds in the bench JSON."""
+    import shutil
+    from veles_tpu.fleet import Fleet
+
+    tmp = None
+    if package is None:
+        tmp = tempfile.mkdtemp(prefix="chaos_bench_")
+        package = build_mnist_package(os.path.join(tmp, "mnist_pkg.zip"))
+    if cache_dir is None:
+        cache_dir = os.path.join(tmp or tempfile.mkdtemp(
+            prefix="chaos_bench_"), "compile_cache")
+    from veles_tpu.export.loader import PackageLoader
+    sample_shape = tuple(PackageLoader(package)
+                         .model_metadata["input"]["sample_shape"])
+
+    # the script: every fault at a fixed data-request ordinal, so the
+    # drill replays identically run after run
+    plans = {
+        "r0": {"seed": 1, "rules": [
+            {"at": 15, "action": "sigkill"}]},
+        "r1": {"seed": 2, "rules": [
+            {"every": 11, "action": "truncate", "bytes": 24},
+            {"at": 40, "action": "sigstop", "resume_after": 2.0}]},
+        "r2": {"seed": 3, "rules": [
+            {"at": 9, "action": "blackhole", "seconds": 2.0}]},
+    }
+    out = {"chaos_replicas": replicas,
+           "chaos_offered_rps": offered_rps,
+           "chaos_seconds": drill_seconds}
+    t0 = time.perf_counter()
+    fleet = Fleet({"mnist": package}, replicas=replicas,
+                  max_batch=max_batch, cache_dir=cache_dir,
+                  poll_interval=0.1, fault_plans=plans,
+                  backoff={"base": 0.2, "factor": 2.0, "cap": 5.0,
+                           "max_restarts": 10})
+    fleet.start(ready_timeout=300)
+    out["chaos_start_s"] = round(time.perf_counter() - t0, 2)
+    try:
+        # sample replica state through the drill: recovery = the first
+        # down transition of the SIGKILLed replica → ready again
+        down_at = {}
+        recovery = {}
+        sampling = threading.Event()
+
+        def sample():
+            while not sampling.wait(0.02):
+                now = time.perf_counter()
+                for rid in fleet.router.replica_ids():
+                    rep = fleet.router.replica(rid)
+                    alive = rep is not None and rep.up and rep.ready
+                    if not alive and rid not in down_at:
+                        down_at[rid] = now
+                    elif alive and rid in down_at \
+                            and rid not in recovery:
+                        recovery[rid] = now - down_at[rid]
+        sampler = threading.Thread(target=sample)
+        sampler.start()
+        drill = _http_status_open_loop(
+            fleet.port, offered_rps, drill_seconds, sizes,
+            sample_shape, headers={"X-Deadline-Ms": "15000"},
+            shed_statuses=(429, 503))
+        # let the killed replica finish respawning before the verdict
+        t_wait = time.perf_counter()
+        while time.perf_counter() - t_wait < 120:
+            if fleet.router.ready_count() == replicas:
+                break
+            time.sleep(0.1)
+        sampling.set()
+        sampler.join()
+        out["chaos_ok"] = drill["ok"]
+        out["chaos_shed"] = drill["shed"]
+        out["chaos_expired"] = drill["expired"]
+        out["chaos_failed"] = drill["failed"]
+        out["chaos_p99_ms"] = _quantiles_ms(
+            drill["latencies"]).get("p99_ms")
+        out["chaos_kill_recovery_s"] = round(recovery["r0"], 2) \
+            if "r0" in recovery else None
+        met = fleet.router.merged_metrics()
+        reps = met["router"]["replicas"]
+        out["chaos_truncated"] = sum(r["truncated"] for r in
+                                     reps.values())
+        out["chaos_aborted"] = sum(r["aborted"] for r in reps.values())
+        out["chaos_retries"] = sum(r["retries"] for r in reps.values())
+        out["chaos_breaker_trips"] = sum(r["breaker_trips"] for r in
+                                         reps.values())
+        out["chaos_restarts"] = sum(
+            v["restarts"] for v in met["supervisor"].values())
+        out["chaos_ready_after"] = fleet.router.ready_count()
+    finally:
+        fleet.stop()
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="serve_bench",
@@ -746,7 +856,36 @@ def main(argv=None):
                         "open-loop load")
     p.add_argument("--drill-seconds", type=float, default=4.0,
                    help="open-loop window for each fleet drill")
+    p.add_argument("--chaos", type=int, default=None, metavar="N",
+                   help="chaos drill mode: N replicas with scripted "
+                        "fault plans (SIGKILL, truncation, black-hole, "
+                        "SIGSTOP) under a deadline-carrying open loop "
+                        "— the zero-failed-responses acceptance drill")
     args = p.parse_args(argv)
+
+    if args.chaos:
+        out = run_chaos_bench(
+            replicas=args.chaos, package=args.package,
+            offered_rps=args.offered_rps or 40.0,
+            drill_seconds=max(args.drill_seconds, 10.0),
+            max_batch=min(args.max_batch, 16),
+            cache_dir=args.cache_dir)
+        line = {"metric": "chaos_failed",
+                "value": out.get("chaos_failed"), "unit": "responses"}
+        line.update(out)
+        if not args.json:
+            print("chaos drill: ok=%s shed=%s expired=%s FAILED=%s; "
+                  "kill recovery %ss, %s truncated / %s retried / %s "
+                  "breaker trips, %s restarts"
+                  % (out.get("chaos_ok"), out.get("chaos_shed"),
+                     out.get("chaos_expired"), out.get("chaos_failed"),
+                     out.get("chaos_kill_recovery_s"),
+                     out.get("chaos_truncated"),
+                     out.get("chaos_retries"),
+                     out.get("chaos_breaker_trips"),
+                     out.get("chaos_restarts")), file=sys.stderr)
+        print(json.dumps(line))
+        return 0
 
     if args.fleet:
         out = run_fleet_bench(

@@ -15,7 +15,8 @@ Public surface:
 
 from .manifest import (CHUNKS_DIR, CKPT_SUFFIX, MANIFEST, TOPOLOGY,
                        Manifest, list_checkpoints)
-from .snapshot import (SnapshotterToShards, import_dir, is_shard_checkpoint,
+from .snapshot import (SnapshotterToShards, delete_checkpoint, import_dir,
+                       is_shard_checkpoint,
                        load_state, open_checkpoint, quarantine_partials,
                        resolve_checkpoint, save_state)
 from .store import ChunkStore, CorruptChunkError
@@ -26,7 +27,8 @@ from .tensors import (ExtractingPickler, ResolvingUnpickler,
 __all__ = [
     "CHUNKS_DIR", "CKPT_SUFFIX", "MANIFEST", "TOPOLOGY",
     "Manifest", "list_checkpoints",
-    "SnapshotterToShards", "import_dir", "is_shard_checkpoint",
+    "SnapshotterToShards", "delete_checkpoint", "import_dir",
+    "is_shard_checkpoint",
     "load_state", "open_checkpoint", "quarantine_partials",
     "resolve_checkpoint", "save_state",
     "ChunkStore", "CorruptChunkError",

@@ -437,6 +437,21 @@ def save_state(directory, name, obj, min_tensor_bytes=1,
     return path
 
 
+def delete_checkpoint(path):
+    """Remove one ``*.ckpt`` directory (a consumed session spill, a
+    superseded state checkpoint).  Only the checkpoint's manifest +
+    topology go away — its chunks are content-addressed and possibly
+    shared, so reclaiming their bytes is the store GC's job
+    (:meth:`SnapshotterToShards.gc_chunks`).  Returns True when
+    something was deleted."""
+    path = resolve_checkpoint(path)
+    if not is_shard_checkpoint(path):
+        raise ValueError("%r is not a shard checkpoint" % path)
+    existed = os.path.isdir(path)
+    shutil.rmtree(path, ignore_errors=True)
+    return existed
+
+
 def load_state(path):
     """Mirror of :func:`save_state`: the object with every tensor leaf
     resolved (host numpy by default)."""

@@ -80,6 +80,15 @@ class RestartBackoff:
         return (self.max_restarts is not None
                 and self.restarts >= self.max_restarts)
 
+    @property
+    def remaining(self):
+        """Restarts left in the budget (``None`` when unlimited) — the
+        supervisor surfaces this in /metrics so an operator sees a
+        crash-looper approaching ``failed`` before it parks."""
+        if self.max_restarts is None:
+            return None
+        return max(self.max_restarts - self.restarts, 0)
+
     def next_delay(self):
         """Grant one restart: seconds to wait before it, or ``None``
         when the budget is exhausted (the caller gives up)."""

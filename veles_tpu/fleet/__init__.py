@@ -18,7 +18,12 @@ router, with zero-downtime rolling model updates.
 - :mod:`.replica` — the replica process entry
   (``python -m veles_tpu.fleet.replica``): a stock
   :class:`~veles_tpu.serving.InferenceServer` with the admin hot-load
-  endpoint on.
+  endpoint on;
+- :mod:`.chaos` — :class:`FaultPlan`: deterministic, scripted fault
+  injection (refuse / black-hole / truncate / latency / SIGKILL /
+  SIGSTOP) installed inside replica subprocesses via
+  ``VELES_FAULT_PLAN`` — what the failover guarantees are tested
+  against.
 
 Quickstart::
 
@@ -32,9 +37,10 @@ or from the CLI: ``python -m veles_tpu.fleet --model mnist=pkg.zip
 --replicas 3``.
 """
 
+from .chaos import FaultPlan
 from .replica import resolve_model_spec
 from .router import FleetRouter
 from .supervisor import Fleet, ReplicaSupervisor
 
-__all__ = ["Fleet", "FleetRouter", "ReplicaSupervisor",
+__all__ = ["FaultPlan", "Fleet", "FleetRouter", "ReplicaSupervisor",
            "resolve_model_spec"]

@@ -55,6 +55,11 @@ def resolve_model_spec(spec):
             return x
 
         return OpaqueModel(fn, sample_shape=(dim,))
+    if isinstance(spec, str) and spec.startswith("toydecode"):
+        # the decode-path stand-in: deterministic, KV-dependent, with
+        # a host oracle — what migration/chaos drills generate against
+        from ..serving.toydecode import from_spec
+        return from_spec(spec)
     return spec
 
 
@@ -65,8 +70,8 @@ def main(argv=None):
                     "with the admin hot-load endpoint on).")
     p.add_argument("--model", action="append", default=[],
                    metavar="NAME=SPEC", dest="models",
-                   help="package zip path or sleep:SECONDS[:DIM] "
-                        "(repeatable)")
+                   help="package zip path, sleep:SECONDS[:DIM], or "
+                        "toydecode:k=v,... (repeatable)")
     p.add_argument("--port", type=int, default=0,
                    help="0 = pick a free port (announced on stdout)")
     p.add_argument("--host", default="127.0.0.1")
@@ -91,6 +96,10 @@ def main(argv=None):
         port=args.port, host=args.host, enable_admin=True,
         model_resolver=resolve_model_spec, max_batch=args.max_batch,
         queue_limit=args.queue_limit, workers=args.workers)
+    # scripted fault injection (VELES_FAULT_PLAN, planted by the
+    # supervisor's fault_plans= knob); clean env → no-op
+    from .chaos import install_from_env
+    install_from_env(server)
     # announce BEFORE warmup: the supervisor learns the address now and
     # gates traffic on /readyz, which stays 503 until every model below
     # finishes its ladder

@@ -61,7 +61,10 @@ class _ReplicaProc:
 
     def describe(self):
         return {"state": self.state, "port": self.port, "pid": self.pid,
-                "restarts": self.backoff.restarts}
+                "failed": self.state == "failed",
+                "restarts": self.backoff.restarts,
+                "restarts_remaining": self.backoff.remaining,
+                "crash_streak": self.backoff.streak}
 
 
 class ReplicaSupervisor:
@@ -78,7 +81,7 @@ class ReplicaSupervisor:
                  host="127.0.0.1", max_batch=64, queue_limit=256,
                  workers=1, cache_dir=None, python=None, env=None,
                  backoff=None, spawn_timeout=180.0, poll_interval=0.1,
-                 clock=time.monotonic):
+                 fault_plans=None, clock=time.monotonic):
         items = models.items() if hasattr(models, "items") else models
         self.models = [(str(n), s) for n, s in items]
         self.router = router
@@ -98,18 +101,26 @@ class ReplicaSupervisor:
             self._replicas[rid] = _ReplicaProc(
                 rid, RestartBackoff(**self._backoff_kw))
         self._env = env
+        # rid → fault plan (dict or JSON string) injected into that
+        # replica's environment — the deterministic chaos hook (see
+        # veles_tpu.fleet.chaos); replicas without a plan run clean
+        self.fault_plans = dict(fault_plans or {})
         self._lock = threading.Lock()
         self._stopping = False
         self._monitor = None
 
     # -- spawning ------------------------------------------------------------
-    def _child_env(self):
+    def _child_env(self, rid=None):
         env = dict(os.environ if self._env is None else self._env)
         if self.cache_dir:
             # the replica resolves its CompileCache/manifest from this
             # (compilecache.resolve_config reads the env var), so every
             # spawn after the first deserializes instead of compiling
             env["VELES_COMPILE_CACHE_DIR"] = str(self.cache_dir)
+        plan = self.fault_plans.get(rid) if rid is not None else None
+        if plan is not None:
+            env["VELES_FAULT_PLAN"] = (plan if isinstance(plan, str)
+                                       else json.dumps(plan))
         env = _trace.inject_env(env) or env
         return _cache_inject_env(env) or env
 
@@ -131,7 +142,8 @@ class ReplicaSupervisor:
         repo = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         handle.proc = subprocess.Popen(
-            self._argv(handle.id), cwd=repo, env=self._child_env(),
+            self._argv(handle.id), cwd=repo,
+            env=self._child_env(handle.id),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         threading.Thread(target=self._drain_stdout, args=(handle,),
                          daemon=True,
@@ -254,6 +266,96 @@ class ReplicaSupervisor:
     def describe(self):
         return {rid: h.describe() for rid, h in self._replicas.items()}
 
+    # -- session migration ---------------------------------------------------
+    def _admin(self, handle, action, body, timeout=60.0):
+        return get_json(self.host, handle.port,
+                        "/admin/sessions/" + action, method="POST",
+                        timeout=timeout, body=body)
+
+    def _pick_target(self, source_rid):
+        for rid in self.replica_ids():
+            handle = self._replicas[rid]
+            if rid != source_rid and handle.state == "up" \
+                    and handle.port is not None:
+                return rid
+        return None
+
+    def migrate_sessions(self, source_rid, target_rid=None):
+        """Move every live decode session off ``source_rid`` to a peer.
+
+        Three phases, each idempotent against a crash between them:
+        export (the source frees the rows and PARKS the clients'
+        futures — nothing is answered yet), import at the target (each
+        session lands independently), release at the source (the
+        parked clients get the 307 redirect the router follows to the
+        new home).  Sessions the target rejected are re-imported at
+        the source — a failed migrate degrades to "nothing moved",
+        never to a lost session."""
+        source = self._replicas[source_rid]
+        if source.port is None:
+            raise RuntimeError("replica %s has no address" % source_rid)
+        status, body = self._admin(source, "export", {})
+        if status != 200:
+            raise RuntimeError("session export on %s answered %s: %s"
+                               % (source_rid, status, body))
+        sessions = (body or {}).get("sessions") or []
+        summary = {"source": source_rid, "target": target_rid,
+                   "moved": [], "restored": [], "errors": []}
+        if not sessions:
+            return summary
+        if target_rid is None:
+            target_rid = self._pick_target(source_rid)
+            summary["target"] = target_rid
+        target = self._replicas.get(target_rid) if target_rid else None
+        imported = []
+        if target is not None and target.port is not None:
+            try:
+                _, tbody = self._admin(target, "import",
+                                       {"sessions": sessions})
+            except _DISPATCH_ERRORS + (ValueError,):
+                tbody = None
+            if isinstance(tbody, dict):
+                imported = [str(s) for s in tbody.get("imported") or []]
+                summary["errors"] = list(tbody.get("errors") or [])
+        if imported:
+            self._admin(source, "release",
+                        {"session_ids": imported,
+                         "target": "%s:%d" % (self.host, target.port)},
+                        timeout=30.0)
+            if self.router is not None:
+                for sid in imported:
+                    self.router.note_session_home(sid, target_rid)
+            summary["moved"] = imported
+        # anything that did not land at the target goes back home —
+        # its parked future is reused, the client never notices
+        landed = set(imported)
+        leftover = [s for s in sessions
+                    if str(s.get("session_id")) not in landed]
+        if leftover:
+            self._admin(source, "import", {"sessions": leftover})
+            summary["restored"] = [str(s.get("session_id"))
+                                   for s in leftover]
+        events.event("fleet.migrate", source=source_rid,
+                     target=target_rid, moved=len(imported),
+                     restored=len(leftover))
+        return summary
+
+    def drain(self, rid, drain_timeout=30.0):
+        """Quiesce one replica: stop NEW dispatch at the router,
+        migrate its live sessions to a peer (so the wait below is
+        bounded by migration time, not by generation length), then
+        wait out the remaining in-flight requests."""
+        if self.router is not None:
+            self.router.set_admitting(rid, False)
+        summary = None
+        try:
+            summary = self.migrate_sessions(rid)
+        except Exception:  # noqa: BLE001 — fall back to waiting it out
+            events.event("fleet.migrate_failed", replica=rid)
+        if self.router is not None:
+            self._drain_router_inflight(rid, drain_timeout)
+        return summary
+
     # -- rolling model updates -----------------------------------------------
     def rolling_update(self, name, spec, version=None,
                        drain_timeout=30.0, admin_timeout=300.0):
@@ -275,6 +377,14 @@ class ReplicaSupervisor:
                 raise RuntimeError("replica %s has no address" % rid)
             if self.router is not None:
                 self.router.set_admitting(rid, False)
+                try:
+                    # live sessions move to a peer instead of pinning
+                    # the drain to their generation length; on any
+                    # migration failure the old behavior (wait out the
+                    # generations) still holds
+                    self.migrate_sessions(rid)
+                except Exception:  # noqa: BLE001
+                    events.event("fleet.migrate_failed", replica=rid)
                 self._drain_router_inflight(rid, drain_timeout)
             try:
                 status, body = get_json(
@@ -347,13 +457,18 @@ class Fleet:
     """
 
     def __init__(self, models, replicas=3, router_port=0,
-                 host="127.0.0.1", poll_interval=0.2, **supervisor_kw):
+                 host="127.0.0.1", poll_interval=0.2,
+                 request_timeout=60.0, **supervisor_kw):
         from .router import FleetRouter
         self.router = FleetRouter(port=router_port, host=host,
-                                  poll_interval=poll_interval)
+                                  poll_interval=poll_interval,
+                                  request_timeout=request_timeout)
         self.supervisor = ReplicaSupervisor(
             models, replicas=replicas, router=self.router, host=host,
             **supervisor_kw)
+        # restart budgets / crash-looper state ride the one merged
+        # /metrics payload the router already serves
+        self.router.supervisor_info = self.supervisor.describe
 
     @property
     def url(self):
@@ -370,6 +485,12 @@ class Fleet:
 
     def rolling_update(self, name, spec, **kwargs):
         return self.supervisor.rolling_update(name, spec, **kwargs)
+
+    def migrate_sessions(self, source_rid, target_rid=None):
+        return self.supervisor.migrate_sessions(source_rid, target_rid)
+
+    def drain(self, rid, **kwargs):
+        return self.supervisor.drain(rid, **kwargs)
 
     def stop(self, drain=True):
         self.supervisor.stop(drain=drain)

@@ -35,12 +35,17 @@ from .decode import DecodeScheduler
 from .kvcache import KVBlockPool
 from .metrics import DecodeMetrics, LatencyWindow, ServingMetrics
 from .registry import DecodeServedModel, ModelRegistry, ServedModel
-from .scheduler import (BucketScheduler, SchedulerClosed,
-                        SchedulerOverflow, bucket_sizes)
+from .scheduler import (BucketScheduler, DeadlineExpired,
+                        SchedulerClosed, SchedulerOverflow,
+                        bucket_sizes, deadline_expired)
 from .server import InferenceServer
+from .sessions import pack_state, pack_states, unpack_state, unpack_states
+from .toydecode import ToyDecodeModel
 
-__all__ = ["BucketScheduler", "DecodeMetrics", "DecodeScheduler",
-           "DecodeServedModel", "InferenceServer", "KVBlockPool",
-           "LatencyWindow", "ModelRegistry", "ServedModel",
-           "SchedulerClosed", "SchedulerOverflow", "ServingMetrics",
-           "bucket_sizes"]
+__all__ = ["BucketScheduler", "DeadlineExpired", "DecodeMetrics",
+           "DecodeScheduler", "DecodeServedModel", "InferenceServer",
+           "KVBlockPool", "LatencyWindow", "ModelRegistry",
+           "ServedModel", "SchedulerClosed", "SchedulerOverflow",
+           "ServingMetrics", "ToyDecodeModel", "bucket_sizes",
+           "deadline_expired", "pack_state", "pack_states",
+           "unpack_state", "unpack_states"]

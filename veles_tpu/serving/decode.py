@@ -37,6 +37,7 @@ import collections
 import queue
 import threading
 import time
+import uuid
 from concurrent.futures import Future
 
 import numpy
@@ -46,21 +47,32 @@ from ..logger import events
 from ..observability import trace as _trace
 from .kvcache import KVBlockPool, required_blocks
 from .metrics import DecodeMetrics
-from .scheduler import SchedulerClosed, SchedulerOverflow, bucket_sizes
+from .scheduler import (DeadlineExpired, SchedulerClosed,
+                        SchedulerOverflow, bucket_sizes,
+                        deadline_expired)
 
 _STOP = object()
+
+#: completed results kept for session re-attach (router failover /
+#: migration races land the client's follow-up after completion)
+_FINISHED_KEEP = 256
 
 
 class _Request:
     __slots__ = ("prompt", "max_new_tokens", "future", "enqueued",
-                 "trace")
+                 "trace", "sid", "deadline")
 
-    def __init__(self, prompt, max_new_tokens):
+    def __init__(self, prompt, max_new_tokens, session_id=None,
+                 deadline=None):
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.future = Future()
         self.enqueued = time.perf_counter()
         self.trace = _trace.current()
+        # every sequence is addressable: an explicit X-Session-Id or a
+        # fresh one — migration and re-attach key on it
+        self.sid = str(session_id) if session_id else uuid.uuid4().hex[:16]
+        self.deadline = deadline    # absolute time.monotonic() or None
 
 
 class _Job:
@@ -144,6 +156,9 @@ class DecodeScheduler:
         self._np_lengths = numpy.zeros(self.max_batch, numpy.int32)
         self._np_tokens = numpy.zeros(self.max_batch, numpy.int32)
         self._sessions = {}          # row -> _Session
+        self._by_sid = {}            # session id -> live _Session
+        self._migrating = {}         # session id -> parked Future
+        self._finished = collections.OrderedDict()  # sid -> result (LRU)
         self._pending = collections.deque()
         self._queue = queue.Queue()
         self._depth = 0              # queued + pending + active
@@ -294,16 +309,22 @@ class DecodeScheduler:
                 % (self.max_new_tokens, max_new_tokens))
         return prompt
 
-    def submit(self, prompt, max_new_tokens=None):
+    def submit(self, prompt, max_new_tokens=None, session_id=None,
+               deadline=None):
         """Enqueue one generate request → Future of
-        ``{"tokens": [...], "ttft_s": float, "prompt_tokens": n}``.
-        Raises SchedulerOverflow / SchedulerClosed / ValueError."""
+        ``{"tokens": [...], "ttft_s": float, "prompt_tokens": n,
+        "session_id": sid}``.  Raises SchedulerOverflow /
+        SchedulerClosed / DeadlineExpired / ValueError."""
         if max_new_tokens is None:
             max_new_tokens = self.max_new_tokens
         prompt = self.validate(prompt, max_new_tokens)
         if self._closed:
             raise SchedulerClosed("decode scheduler %r is draining"
                                   % self.name)
+        if deadline_expired(deadline):
+            self.metrics.record_expired()
+            raise DeadlineExpired(
+                "deadline passed before admission to %r" % self.name)
         with self._depth_lock:
             if self._depth >= self.queue_limit:
                 self.metrics.record_reject()
@@ -311,13 +332,17 @@ class DecodeScheduler:
                     "decode queue full (%d outstanding, limit %d)"
                     % (self._depth, self.queue_limit))
             self._depth += 1
-        req = _Request(prompt, max_new_tokens)
+        req = _Request(prompt, max_new_tokens, session_id=session_id,
+                       deadline=deadline)
         self._queue.put(req)
         return req.future
 
-    def generate(self, prompt, max_new_tokens=None, timeout=None):
+    def generate(self, prompt, max_new_tokens=None, timeout=None,
+                 session_id=None, deadline=None):
         """Blocking :meth:`submit`."""
-        return self.submit(prompt, max_new_tokens).result(timeout)
+        return self.submit(prompt, max_new_tokens,
+                           session_id=session_id,
+                           deadline=deadline).result(timeout)
 
     # -- worker --------------------------------------------------------------
     def _worker_loop(self):
@@ -375,6 +400,10 @@ class DecodeScheduler:
         for row in list(self._sessions):
             session = self._sessions[row]
             self._retire(session, error=exc)
+        for sid in list(self._migrating):
+            future = self._migrating.pop(sid)
+            if future.set_running_or_notify_cancel():
+                future.set_exception(exc)
 
     # -- admission / prefill -------------------------------------------------
     def _free_rows(self):
@@ -382,6 +411,21 @@ class DecodeScheduler:
                 if r not in self._sessions]
 
     def _admit(self):
+        # shed queue-expired work FIRST: a request whose deadline passed
+        # while it waited must not block the head of the line or spend
+        # a prefill on an answer nobody is waiting for
+        if self._pending:
+            now = time.monotonic()
+            live = collections.deque()
+            while self._pending:
+                req = self._pending.popleft()
+                if deadline_expired(req.deadline, now):
+                    self.metrics.record_expired()
+                    self._fail(req, DeadlineExpired(
+                        "deadline passed before prefill"))
+                else:
+                    live.append(req)
+            self._pending = live
         rows = self._free_rows()
         while self._pending and rows:
             req = self._pending[0]
@@ -402,6 +446,7 @@ class DecodeScheduler:
                 rows.insert(0, row)
                 continue
             self._sessions[row] = session
+            self._by_sid[req.sid] = session
             self.metrics.record_admit(len(req.prompt))
             if session.done:        # max_new_tokens == 1: prefill was all
                 self._retire(session)
@@ -446,6 +491,12 @@ class DecodeScheduler:
             self._k_pools, self._v_pools, self._np_table,
             self._np_lengths, self._np_tokens)
         next_tokens = numpy.asarray(next_tokens)     # D2H sync point
+        # stand-in hook (mirrors the fleet's ``sleep:`` philosophy): a
+        # test model can pin per-step wall time so migration drills get
+        # a real mid-generation window without XLA cost
+        delay = getattr(self.model, "step_host_delay", 0)
+        if delay:
+            time.sleep(delay)
         dt = time.perf_counter() - t0
         active = list(self._sessions.values())
         for session in active:
@@ -461,6 +512,7 @@ class DecodeScheduler:
 
     def _retire(self, session, error=None):
         self._sessions.pop(session.row, None)
+        self._by_sid.pop(session.req.sid, None)
         self._pool.free(session.blocks)
         self._np_table[session.row, :] = 0
         self._np_lengths[session.row] = 0
@@ -473,12 +525,19 @@ class DecodeScheduler:
                 future.set_exception(error)
         else:
             self.metrics.record_complete(len(session.generated))
+            result = {
+                "tokens": [int(t) for t in session.generated],
+                "prompt_tokens": len(session.req.prompt),
+                "ttft_s": round(session.first_token_s, 6),
+                "session_id": session.req.sid,
+            }
+            # keep the result for re-attach: a migrated session's
+            # follow-up (or a router retry) may arrive AFTER completion
+            self._finished[session.req.sid] = result
+            while len(self._finished) > _FINISHED_KEEP:
+                self._finished.popitem(last=False)
             if future.set_running_or_notify_cancel():
-                future.set_result({
-                    "tokens": [int(t) for t in session.generated],
-                    "prompt_tokens": len(session.req.prompt),
-                    "ttft_s": round(session.first_token_s, 6),
-                })
+                future.set_result(result)
         self._release()
 
     # -- KV checkpoint / restore ---------------------------------------------
@@ -589,6 +648,258 @@ class DecodeScheduler:
             max(self._pool.capacity, 1))
         return futures
 
+    # -- live session migration ----------------------------------------------
+    # Per-SEQUENCE checkpointing on the checkpoint_kv pytree path: a
+    # session's state at a step boundary is its token bookkeeping plus
+    # the K/V contents of ITS blocks (gathered host-side), which makes
+    # a mid-generation sequence portable to any peer scheduler with the
+    # same block size — there it is just another row in the running
+    # batch (the ragged paged layout's whole point).  Export PARKS the
+    # original request future instead of completing it: the source only
+    # answers (with a "migrated" redirect marker) after release_migrated
+    # confirms the target imported, so the client's follow-up can never
+    # race an import that failed.
+
+    def export_sessions(self, session_ids=None):
+        """Export live sessions (all, or the given ids) as portable
+        state dicts at a step boundary.  Exported sessions leave this
+        scheduler (rows and blocks freed, futures parked) — follow with
+        :meth:`import_sessions` on a peer and :meth:`release_migrated`
+        here, or re-import locally to abort."""
+        return self._run_job(lambda: self._export_sessions(session_ids))
+
+    def import_sessions(self, states):
+        """Adopt exported sessions mid-generation.  Imports each state
+        independently; returns ``(imported_ids, errors)`` where errors
+        is ``[(sid, reason), ...]`` — the caller (supervisor) releases
+        the imported ones and restores the failed ones to the source."""
+        def job():
+            done, errors = [], []
+            for state in states:
+                try:
+                    done.append(self._import_session(state))
+                except Exception as exc:  # noqa: BLE001 — per-session
+                    errors.append((str(state.get("session_id")),
+                                   str(exc)))
+            return done, errors
+        return self._run_job(job)
+
+    def release_migrated(self, session_ids, target=None):
+        """Complete the parked futures of exported sessions with a
+        ``{"migrated": True, "target": ...}`` marker — the source-side
+        commit, answered only after the target imported."""
+        return self._run_job(
+            lambda: self._release_migrated(session_ids, target))
+
+    def attach(self, session_id):
+        """Re-attach to a session by id: ``("live", future)`` while it
+        decodes (or is parked mid-migration), ``("finished", result)``
+        after completion, None when unknown."""
+        return self._run_job(lambda: self._attach(session_id))
+
+    def session_ids(self):
+        """Session-id snapshot: active / migrating / finished."""
+        return self._run_job(lambda: {
+            "active": sorted(self._by_sid)
+            + sorted(r.sid for r in self._pending),
+            "migrating": sorted(self._migrating),
+            "finished": list(self._finished)})
+
+    def spill_session(self, session_id, directory):
+        """Spill one (idle) session to a host-side sharded checkpoint
+        and free its row/blocks; any waiter gets a ``{"spilled": True}``
+        marker.  Re-admit later with :meth:`readmit_session`."""
+        return self._run_job(
+            lambda: self._spill_session(session_id, directory))
+
+    def readmit_session(self, path, delete=True):
+        """Re-admit a spilled session into the running batch; returns
+        its id (collect the result via :meth:`attach`)."""
+        return self._run_job(lambda: self._readmit_session(path, delete))
+
+    def _export_sessions(self, session_ids=None):
+        want = None if session_ids is None else set(session_ids)
+        states = []
+        for session in list(self._sessions.values()):
+            if want is not None and session.req.sid not in want:
+                continue
+            states.append(self._export_one(session))
+        # queued-but-unprefilled requests ride along as prompt-only
+        # states (no KV yet — the peer prefills them from scratch)
+        keep = collections.deque()
+        while self._pending:
+            req = self._pending.popleft()
+            if want is not None and req.sid not in want:
+                keep.append(req)
+                continue
+            states.append(self._fresh_state(req))
+            self._migrating[req.sid] = req.future
+            self._release()
+        self._pending = keep
+        if states:
+            self.metrics.record_migrate(len(states), "out")
+            self.metrics.set_occupancy(
+                len(self._sessions), self._pool.live_blocks /
+                max(self._pool.capacity, 1))
+        return states
+
+    def _fresh_state(self, req):
+        return {"session_id": req.sid,
+                "prompt": numpy.array(req.prompt),
+                "max_new_tokens": int(req.max_new_tokens),
+                "block_size": self.block_size,
+                "deadline_left_s": None if req.deadline is None
+                else max(req.deadline - time.monotonic(), 0.0)}
+
+    def _export_one(self, session):
+        req = session.req
+        blocks = numpy.asarray(session.blocks, numpy.int64)
+        tree = self._jax.tree_util
+        gather = lambda pool: numpy.asarray(pool[blocks])  # noqa: E731
+        state = self._fresh_state(req)
+        state.update({
+            "length": int(session.length),
+            "next_input": int(session.next_input),
+            "generated": [int(t) for t in session.generated],
+            "first_token_s": float(session.first_token_s or 0.0),
+            "kv_k": tree.tree_leaves(tree.tree_map(gather,
+                                                   self._k_pools)),
+            "kv_v": tree.tree_leaves(tree.tree_map(gather,
+                                                   self._v_pools)),
+        })
+        self._sessions.pop(session.row, None)
+        self._by_sid.pop(req.sid, None)
+        self._pool.free(session.blocks)
+        self._np_table[session.row, :] = 0
+        self._np_lengths[session.row] = 0
+        self._np_tokens[session.row] = 0
+        self._migrating[req.sid] = req.future
+        self._release()
+        return state
+
+    def _import_session(self, state):
+        sid = str(state["session_id"])
+        if sid in self._by_sid or any(r.sid == sid
+                                      for r in self._pending):
+            raise ValueError("session %r is already live here" % sid)
+        if int(state["block_size"]) != self.block_size:
+            raise ValueError(
+                "block_size mismatch: session %s vs scheduler %s"
+                % (state["block_size"], self.block_size))
+        prompt = self.validate(numpy.asarray(state["prompt"]),
+                               state["max_new_tokens"])
+        deadline = None
+        if state.get("deadline_left_s") is not None:
+            deadline = time.monotonic() + float(state["deadline_left_s"])
+        req = _Request(prompt, state["max_new_tokens"],
+                       session_id=sid, deadline=deadline)
+        # the parked future, when this is a source-side abort/restore —
+        # the original waiter stays attached through the round trip
+        parked = self._migrating.pop(sid, None)
+        if parked is not None:
+            req.future = parked
+        if state.get("kv_k") is None:       # prompt-only: just enqueue
+            self._pending.append(req)
+            with self._depth_lock:
+                self._depth += 1
+            return sid
+        rows = self._free_rows()
+        n_blocks = int(numpy.shape(state["kv_k"][0])[0])
+        blocks = self._pool.alloc(n_blocks) if rows else None
+        if blocks is None:
+            if parked is not None:          # re-park: caller may retry
+                self._migrating[sid] = parked
+            raise RuntimeError(
+                "no capacity to import session %r (%d blocks, %d free; "
+                "%d rows free)" % (sid, n_blocks,
+                                   self._pool.free_blocks, len(rows)))
+        tree = self._jax.tree_util
+        jnp = self._jax.numpy
+        blocks_arr = numpy.asarray(blocks, numpy.int64)
+        structure = tree.tree_structure(self._k_pools)
+        scatter = lambda pool, host: pool.at[blocks_arr].set(  # noqa: E731
+            jnp.asarray(host))
+        self._k_pools = tree.tree_map(
+            scatter, self._k_pools,
+            tree.tree_unflatten(structure, state["kv_k"]))
+        self._v_pools = tree.tree_map(
+            scatter, self._v_pools,
+            tree.tree_unflatten(structure, state["kv_v"]))
+        row = rows.pop(0)
+        session = _Session(req, row, blocks)
+        session.length = int(state["length"])
+        session.next_input = int(state["next_input"])
+        session.generated = [int(t) for t in state["generated"]]
+        session.first_token_s = float(state["first_token_s"])
+        self._np_table[row, :] = 0
+        self._np_table[row, :len(blocks)] = blocks
+        self._np_lengths[row] = session.length
+        self._np_tokens[row] = session.next_input
+        self._sessions[row] = session
+        self._by_sid[sid] = session
+        with self._depth_lock:
+            self._depth += 1
+        self.metrics.record_migrate(1, "in")
+        self.metrics.set_occupancy(
+            len(self._sessions), self._pool.live_blocks /
+            max(self._pool.capacity, 1))
+        return sid
+
+    def _release_migrated(self, session_ids, target):
+        released = []
+        for sid in session_ids:
+            future = self._migrating.pop(sid, None)
+            if future is None:
+                continue
+            if future.set_running_or_notify_cancel():
+                future.set_result({"migrated": True, "session_id": sid,
+                                   "target": target})
+            released.append(sid)
+        return released
+
+    def _attach(self, session_id):
+        sid = str(session_id)
+        session = self._by_sid.get(sid)
+        if session is not None:
+            return "live", session.req.future
+        for req in self._pending:
+            if req.sid == sid:
+                return "live", req.future
+        if sid in self._migrating:
+            return "live", self._migrating[sid]
+        if sid in self._finished:
+            return "finished", self._finished[sid]
+        return None
+
+    def _spill_session(self, session_id, directory):
+        from ..checkpoint import save_state
+        sid = str(session_id)
+        session = self._by_sid.get(sid)
+        if session is None:
+            raise KeyError("no live session %r to spill" % sid)
+        state = self._export_one(session)
+        path = save_state(directory, "session-" + sid, state,
+                          meta={"kind": "decode_session",
+                                "scheduler": self.name,
+                                "session_id": sid})
+        future = self._migrating.pop(sid, None)
+        if future is not None and future.set_running_or_notify_cancel():
+            future.set_result({"spilled": True, "session_id": sid,
+                               "path": str(path)})
+        events.event("serving.session_spill", model=self.name,
+                     session=sid)
+        return str(path)
+
+    def _readmit_session(self, path, delete):
+        from ..checkpoint import delete_checkpoint, load_state
+        state = load_state(path)
+        sid = self._import_session(state)
+        if delete:
+            delete_checkpoint(path)
+        events.event("serving.session_readmit", model=self.name,
+                     session=sid)
+        return sid
+
     # -- lifecycle / introspection -------------------------------------------
     def close(self, drain=True, timeout=30.0):
         """Stop accepting; with ``drain`` every already-submitted
@@ -666,6 +977,7 @@ class DecodeScheduler:
             "queue_limit": self.queue_limit,
             "max_batch": self.max_batch,
             "active_sequences": len(self._sessions),
+            "migrating_sessions": len(self._migrating),
             "block_size": self.block_size,
             "num_blocks": pool["num_blocks"],
             "free_blocks": pool["free_blocks"],

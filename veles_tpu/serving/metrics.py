@@ -94,6 +94,9 @@ _COUNTERS = {
                    "Real rows across executed batches"),
     "padded_rows": ("veles_serving_padded_rows_total",
                     "Padding rows added by power-of-two bucketing"),
+    "expired": ("veles_serving_deadline_expired_total",
+                "Requests shed because their end-to-end deadline "
+                "passed before device time was spent (HTTP 504)"),
 }
 
 
@@ -179,6 +182,10 @@ class ServingMetrics:
         self._c["rejected"].inc()
         events.event("serving.reject", model=self.model)
 
+    def record_expired(self):
+        self._c["expired"].inc()
+        events.event("serving.deadline_expired", model=self.model)
+
     def record_compile(self, seconds):
         """One bucket executable produced (compile or cache load)."""
         self._c_compile_s.inc(float(seconds))
@@ -263,6 +270,13 @@ _DECODE_COUNTERS = {
     "idle_rows": ("veles_serving_decode_idle_rows_total",
                   "Padding rows across decode steps (sum) — the "
                   "utilization the request-granularity path wastes"),
+    "expired": ("veles_serving_decode_deadline_expired_total",
+                "Generate requests shed because their deadline passed "
+                "before prefill (HTTP 504)"),
+    "migrated_out": ("veles_serving_decode_migrated_out_total",
+                     "Live sessions exported to a peer or spilled"),
+    "migrated_in": ("veles_serving_decode_migrated_in_total",
+                    "Live sessions imported mid-generation"),
 }
 
 
@@ -350,6 +364,14 @@ class DecodeMetrics:
     def record_reject(self):
         self._c["rejected"].inc()
         events.event("serving.decode_reject", model=self.model)
+
+    def record_expired(self):
+        self._c["expired"].inc()
+        events.event("serving.decode_deadline_expired", model=self.model)
+
+    def record_migrate(self, n, direction="out"):
+        self._c["migrated_out" if direction == "out"
+                else "migrated_in"].inc(int(n))
 
     def set_occupancy(self, active_rows, kv_ratio):
         self._g_active.set(int(active_rows))

@@ -34,9 +34,10 @@ class ServedModel:
         self.version = version
         self.created = time.time()
 
-    def infer(self, batch, timeout=None):
+    def infer(self, batch, timeout=None, deadline=None):
         """→ (result, output) — the protocol tuple the handlers serve."""
-        out = self.scheduler.infer(batch, timeout=timeout)
+        out = self.scheduler.infer(batch, timeout=timeout,
+                                   deadline=deadline)
         if self.transform is not None:
             result = self.transform(out)
         elif out.ndim == 2 and out.shape[1] > 1:
@@ -71,10 +72,14 @@ class DecodeServedModel:
         self.version = version
         self.created = time.time()
 
-    def generate(self, prompt, max_new_tokens=None, timeout=None):
-        """→ the result dict (tokens, ttft_s, prompt_tokens)."""
+    def generate(self, prompt, max_new_tokens=None, timeout=None,
+                 session_id=None, deadline=None):
+        """→ the result dict (tokens, ttft_s, prompt_tokens,
+        session_id)."""
         return self.scheduler.generate(prompt, max_new_tokens,
-                                       timeout=timeout)
+                                       timeout=timeout,
+                                       session_id=session_id,
+                                       deadline=deadline)
 
     def describe(self):
         stats = self.scheduler.stats()
@@ -150,6 +155,9 @@ class ModelRegistry:
                            "max_new_tokens", "num_blocks",
                            "queue_limit", "cache", "manifest",
                            "warmup")}
+        # a model may carry its own geometry (the toydecode spec path):
+        # registry-wide defaults < model defaults < explicit kwargs
+        kwargs.update(getattr(model, "decode_defaults", None) or {})
         kwargs.update(decode_kwargs)
         scheduler = DecodeScheduler(
             model, name=name,

@@ -46,6 +46,20 @@ class SchedulerClosed(RuntimeError):
     """The scheduler is draining or stopped — no new requests."""
 
 
+class DeadlineExpired(RuntimeError):
+    """The request's end-to-end deadline passed before it reached the
+    device — shed (HTTP 504) instead of spending batch rows on an
+    answer nobody is waiting for."""
+
+
+def deadline_expired(deadline, now=None):
+    """True when an absolute ``time.monotonic()`` deadline has passed
+    (None = no deadline)."""
+    if deadline is None:
+        return False
+    return (time.monotonic() if now is None else now) >= deadline
+
+
 def bucket_sizes(max_batch):
     """The power-of-two bucket ladder: 1, 2, 4, ... max_batch."""
     if max_batch < 1:
@@ -148,9 +162,9 @@ def adapt_model(model, sample_shape=None):
 
 
 class _Pending:
-    __slots__ = ("x", "n", "future", "enqueued", "trace")
+    __slots__ = ("x", "n", "future", "enqueued", "trace", "deadline")
 
-    def __init__(self, x):
+    def __init__(self, x, deadline=None):
         self.x = x
         self.n = int(x.shape[0])
         self.future = Future()
@@ -159,6 +173,10 @@ class _Pending:
         # request span): the dispatch worker links the batch span back
         # to every request it served
         self.trace = _trace.current()
+        # absolute time.monotonic() end-to-end deadline (None = none):
+        # checked at admission AND again just before batching, so work
+        # that expired in the queue never reaches the executable
+        self.deadline = deadline
 
 
 _STOP = object()
@@ -340,22 +358,26 @@ class BucketScheduler:
                 "sample shape %s does not match the model's %s"
                 % (list(x.shape[1:]), list(self.sample_shape)))
 
-    def submit(self, x):
+    def submit(self, x, deadline=None):
         """Enqueue one request batch (≤ max_batch rows) → Future of the
         output rows.  Raises SchedulerOverflow / SchedulerClosed /
-        ValueError (bad shape)."""
+        DeadlineExpired / ValueError (bad shape)."""
         x = numpy.ascontiguousarray(x, numpy.float32)
         self.validate(x)
         if x.shape[0] > self.max_batch:
             raise ValueError("request of %d rows exceeds max_batch=%d "
                              "(use infer(), which chunks)"
                              % (x.shape[0], self.max_batch))
-        return self._enqueue(x)
+        return self._enqueue(x, deadline)
 
-    def _enqueue(self, x):
+    def _enqueue(self, x, deadline=None):
         """The validated hot path: bound check, depth accounting, queue."""
         if self._closed:
             raise SchedulerClosed("scheduler %r is shut down" % self.name)
+        if deadline_expired(deadline):
+            self.metrics.record_expired()
+            raise DeadlineExpired(
+                "deadline passed before admission to %r" % self.name)
         with self._depth_lock:
             if self._depth >= self.queue_limit:
                 self.metrics.record_reject()
@@ -363,17 +385,17 @@ class BucketScheduler:
                     "queue full (%d outstanding, limit %d)"
                     % (self._depth, self.queue_limit))
             self._depth += 1
-        req = _Pending(x)
+        req = _Pending(x, deadline)
         self._queue.put(req)
         return req.future
 
-    def infer(self, x, timeout=None):
+    def infer(self, x, timeout=None, deadline=None):
         """Blocking inference of any batch size: chunk to ≤ max_batch,
         submit, concatenate.  Returns the output as a numpy array."""
         x = numpy.ascontiguousarray(x, numpy.float32)
         self.validate(x)
         t0 = time.perf_counter()
-        futures = [self._enqueue(x[i:i + self.max_batch])
+        futures = [self._enqueue(x[i:i + self.max_batch], deadline)
                    for i in range(0, x.shape[0], self.max_batch)]
         try:
             parts = [f.result(timeout) for f in futures]
@@ -428,6 +450,21 @@ class BucketScheduler:
             self._execute(batch, rows)
 
     def _execute(self, batch, rows):
+        # pre-batch deadline check: a request that expired while queued
+        # is shed HERE — it never occupies a bucket row or device time
+        now = time.monotonic()
+        expired = [r for r in batch if deadline_expired(r.deadline, now)]
+        if expired:
+            exc = DeadlineExpired("deadline passed in queue")
+            for r in expired:
+                self.metrics.record_expired()
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(exc)
+                rows -= r.n
+            self._release(len(expired))
+            batch = [r for r in batch if r not in expired]
+            if not batch:
+                return
         t0 = time.perf_counter()
         try:
             bucket = self._bucket_for(rows)

@@ -42,13 +42,16 @@ import logging
 import threading
 import time
 import uuid
+from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import ThreadingHTTPServer
 
 from ..httpjson import ClientError, JsonRequestHandler
 from ..logger import events
 from ..observability import trace as _trace
 from .registry import ModelRegistry
-from .scheduler import SchedulerClosed, SchedulerOverflow
+from .scheduler import (DeadlineExpired, SchedulerClosed,
+                        SchedulerOverflow, deadline_expired)
+from .sessions import pack_state, unpack_states
 
 log = logging.getLogger("veles_tpu.serving")
 
@@ -57,13 +60,18 @@ class _ServingHandler(JsonRequestHandler):
     server_ref = None           # class attr bound per InferenceServer
     protocol_version = "HTTP/1.1"
     disable_nagle_algorithm = True
-    timeout = 60                # reap idle keep-alive connections
+    # reap idle keep-alive connections; overridden per server from
+    # request_timeout (single source of truth — see InferenceServer)
+    timeout = 60
 
     # -- routes --------------------------------------------------------------
     def do_POST(self):
         path = self.path.split("?", 1)[0].rstrip("/")
         if path == "/admin/models":
             self._admin_load()
+            return
+        if path.startswith("/admin/sessions/"):
+            self._admin_sessions(path[len("/admin/sessions/"):])
             return
         if path != "/api" and not path.startswith("/api/"):
             self.send_json(404, {"error": "not found"})
@@ -103,8 +111,44 @@ class _ServingHandler(JsonRequestHandler):
             self.send_json(200, srv.registry.metrics_snapshot())
         elif path == "/models":
             self.send_json(200, srv.registry.describe())
+        elif path == "/admin/sessions" and srv.enable_admin:
+            out = {}
+            for name in srv.registry.names():
+                entry = srv.registry.get(name)
+                if entry is not None and \
+                        hasattr(entry.scheduler, "session_ids"):
+                    out[name] = entry.scheduler.session_ids()
+            self.send_json(200, {"sessions": out})
         else:
             self.send_json(404, {"error": "not found"})
+
+    # -- deadlines -----------------------------------------------------------
+    def _deadline(self):
+        """``X-Deadline-Ms`` (REMAINING budget in ms — relative, so no
+        cross-process clock agreement is needed) → an absolute
+        ``time.monotonic()`` deadline, or None."""
+        raw = self.headers.get("X-Deadline-Ms")
+        if not raw:
+            return None
+        try:
+            ms = float(raw)
+        except ValueError:
+            return None
+        return time.monotonic() + max(ms, 0.0) / 1e3
+
+    def _shed_expired(self, trace_hdr=None):
+        self.send_json(504, {"error": "deadline expired"},
+                       headers=trace_hdr or {})
+        return 504
+
+    def _result_timeout(self, deadline):
+        """How long to block on a future: the configured request
+        timeout, tightened to the request's remaining deadline."""
+        timeout = self.server_ref.request_timeout
+        if deadline is not None:
+            timeout = min(timeout, max(deadline - time.monotonic(),
+                                       0.001))
+        return timeout
 
     # -- load shedding -------------------------------------------------------
     def _shed(self, entry, message, close=False, trace_hdr=None):
@@ -161,6 +205,103 @@ class _ServingHandler(JsonRequestHandler):
                              "version": entry.version,
                              "ready": entry.scheduler.ready})
 
+    # -- admin: session migration --------------------------------------------
+    def _decode_entries(self, model=None):
+        """(name, entry) pairs whose schedulers speak the session
+        protocol, optionally restricted to one model name."""
+        srv = self.server_ref
+        names = [model] if model else srv.registry.names()
+        out = []
+        for name in names:
+            entry = srv.registry.get(name)
+            if entry is not None and \
+                    hasattr(entry.scheduler, "export_sessions"):
+                out.append((name, entry))
+        return out
+
+    def _admin_sessions(self, action):
+        """``POST /admin/sessions/{export,import,release}`` — the
+        supervisor's migration surface (``enable_admin`` only).
+
+        export:  {"model"?, "session_ids"?} → {"sessions": [packed]}
+                 (each tagged with its model name; exported sessions
+                 are PARKED here until release confirms the import)
+        import:  {"sessions": [packed]} → {"imported": [...],
+                 "errors": [[sid, reason], ...]} — each session lands
+                 independently, so a partial failure is visible and
+                 the caller restores only the failed ones
+        release: {"session_ids": [...], "target"?} → completes the
+                 parked futures with a redirect marker
+        """
+        srv = self.server_ref
+        if not srv.enable_admin:
+            self.send_json(404, {"error": "not found"})
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError
+        except ValueError:
+            self.send_json(400, {"error": "body is not a JSON object"})
+            return
+        try:
+            if action == "export":
+                sids = payload.get("session_ids")
+                sessions = []
+                for name, entry in self._decode_entries(
+                        payload.get("model")):
+                    for state in entry.scheduler.export_sessions(sids):
+                        sessions.append(
+                            dict(pack_state(state), model=name))
+                self.send_json(200, {"sessions": sessions,
+                                     "count": len(sessions)})
+            elif action == "import":
+                raw = payload.get("sessions") or []
+                by_model = {}
+                for packed in raw:
+                    by_model.setdefault(
+                        packed.get("model"), []).append(packed)
+                imported, errors = [], []
+                for model, group in by_model.items():
+                    entries = self._decode_entries(model)
+                    if not entries:
+                        errors.extend(
+                            (p.get("session_id"),
+                             "no decode model %r" % model)
+                            for p in group)
+                        continue
+                    states = unpack_states(group)
+                    for s in states:
+                        s.pop("model", None)
+                    done, errs = entries[0][1].scheduler \
+                        .import_sessions(states)
+                    imported.extend(done)
+                    errors.extend(errs)
+                # 409 when NOTHING landed (and something was sent):
+                # the exporter keeps everything and aborts the migrate
+                status = 409 if raw and not imported else 200
+                self.send_json(status, {
+                    "imported": imported,
+                    "errors": [[sid, str(reason)]
+                               for sid, reason in errors]})
+            elif action == "release":
+                sids = payload.get("session_ids") or []
+                target = payload.get("target")
+                released = []
+                for name, entry in self._decode_entries(
+                        payload.get("model")):
+                    released.extend(entry.scheduler.release_migrated(
+                        sids, target=target))
+                self.send_json(200, {"released": released})
+            else:
+                self.send_json(404, {"error": "unknown session action "
+                                              "%r" % action})
+        except Exception as exc:  # noqa: BLE001 — report, keep serving
+            log.exception("admin session %s failed", action)
+            self.send_json(500, {"error": "%s failed: %s"
+                                 % (action, str(exc)[:300])})
+
     # -- the inference path --------------------------------------------------
     def _infer(self, name):
         # request → batch → executable causality: the request runs in a
@@ -195,11 +336,30 @@ class _ServingHandler(JsonRequestHandler):
         except ValueError as e:             # shape mismatch et al.
             self.send_json(400, {"error": str(e)}, headers=trace_hdr)
             return 400
+        deadline = self._deadline()
+        if deadline_expired(deadline):
+            # expired before submission: shed without touching the
+            # scheduler queue at all
+            entry.scheduler.metrics.record_expired()
+            return self._shed_expired(trace_hdr)
         try:
-            result, out = entry.infer(batch, timeout=srv.request_timeout)
+            result, out = entry.infer(
+                batch, timeout=self._result_timeout(deadline),
+                deadline=deadline)
         except SchedulerOverflow as e:
             return self._shed(entry, "server overloaded: %s" % e,
                               trace_hdr=trace_hdr)
+        except DeadlineExpired:
+            return self._shed_expired(trace_hdr)
+        except _FutureTimeout:
+            if deadline_expired(deadline):
+                return self._shed_expired(trace_hdr)
+            log.warning("inference on %r exceeded request_timeout",
+                        entry.name)
+            self.send_json(500, {"error": "request timed out",
+                                 "model": entry.name},
+                           headers=trace_hdr)
+            return 500
         except SchedulerClosed:
             self.send_json(503, {"error": "server is draining"},
                            headers={"Connection": "close", **trace_hdr})
@@ -229,7 +389,8 @@ class _ServingHandler(JsonRequestHandler):
                         model=name or "<default>", status=status)
 
     def _read_generate_payload(self):
-        """{"prompt": [...], "max_new_tokens": n?} → (prompt, n)."""
+        """{"prompt": [...], "max_new_tokens": n?, "session_id": s?}
+        → (prompt, n, session_id)."""
         length = int(self.headers.get("Content-Length", 0))
         try:
             payload = json.loads(self.rfile.read(length))
@@ -242,14 +403,18 @@ class _ServingHandler(JsonRequestHandler):
         max_new = payload.get("max_new_tokens")
         if max_new is not None and not isinstance(max_new, int):
             raise ClientError("'max_new_tokens' must be an integer")
-        return payload["prompt"], max_new
+        sid = payload.get("session_id")
+        if sid is not None and not isinstance(sid, str):
+            raise ClientError("'session_id' must be a string")
+        return payload["prompt"], max_new, sid
 
     def _generate_traced(self, name, ctx):
         srv = self.server_ref
         entry = srv.registry.resolve(name)
         trace_hdr = {"X-Trace-Id": ctx.trace_id}
         try:
-            prompt, max_new = self._read_generate_payload()
+            prompt, max_new, sid = self._read_generate_payload()
+            sid = self.headers.get("X-Session-Id") or sid
             if entry is None:
                 self.send_json(404, {
                     "error": "unknown model %r" % (name or "<default>"),
@@ -270,12 +435,42 @@ class _ServingHandler(JsonRequestHandler):
         except (ValueError, TypeError) as e:
             self.send_json(400, {"error": str(e)}, headers=trace_hdr)
             return 400
+        deadline = self._deadline()
+        if deadline_expired(deadline):
+            entry.scheduler.metrics.record_expired()
+            return self._shed_expired(trace_hdr)
+        # the router's migration follow: the session should already be
+        # (or shortly be) live here — attach instead of re-generating
+        attach = self.headers.get("X-Veles-Attach") == "1"
         try:
-            result = entry.generate(prompt, max_new,
-                                    timeout=srv.request_timeout)
+            result = None
+            if sid:
+                result = self._session_result(entry, sid, deadline,
+                                              attach)
+            if result is None:
+                if attach:
+                    self.send_json(410, {"error": "unknown session",
+                                         "session_id": sid},
+                                   headers=trace_hdr)
+                    return 410
+                result = entry.generate(
+                    prompt, max_new,
+                    timeout=self._result_timeout(deadline),
+                    session_id=sid, deadline=deadline)
         except SchedulerOverflow as e:
             return self._shed(entry, "server overloaded: %s" % e,
                               trace_hdr=trace_hdr)
+        except DeadlineExpired:
+            return self._shed_expired(trace_hdr)
+        except _FutureTimeout:
+            if deadline_expired(deadline):
+                return self._shed_expired(trace_hdr)
+            log.warning("generate on %r exceeded request_timeout",
+                        entry.name)
+            self.send_json(500, {"error": "request timed out",
+                                 "model": entry.name},
+                           headers=trace_hdr)
+            return 500
         except SchedulerClosed:
             # drain: in-flight sequences finish, NEW generate submits
             # shed with retryable backpressure (429 + Retry-After), so
@@ -290,9 +485,46 @@ class _ServingHandler(JsonRequestHandler):
                                  "model": entry.name, "id": error_id},
                            headers=trace_hdr)
             return 500
+        if isinstance(result, dict) and result.get("migrated"):
+            # the session moved while this request was held: answer a
+            # redirect the fleet router follows to the new home (the
+            # generated-so-far tokens rode along, so the target answers
+            # the complete, bitwise-identical sequence)
+            headers = dict(trace_hdr)
+            headers["X-Veles-Migrated"] = str(
+                result.get("session_id") or sid or "")
+            if result.get("target"):
+                headers["X-Veles-Session-Target"] = str(result["target"])
+            self.send_json(307, dict(result, model=entry.name),
+                           headers=headers)
+            return 307
         self.send_json(200, dict(result, model=entry.name),
                        headers=trace_hdr)
         return 200
+
+    def _session_result(self, entry, sid, deadline, attach):
+        """The result of an EXISTING session ``sid`` — waits on the
+        live future, returns a finished result immediately, or None
+        when the id is unknown (caller submits fresh).  In attach mode
+        (a migration follow) it polls briefly: the redirect can land a
+        beat before the target's import commits."""
+        scheduler = entry.scheduler
+        if not hasattr(scheduler, "attach"):
+            return None
+        wait_until = time.monotonic() + (
+            self.server_ref.attach_wait if attach else 0.0)
+        while True:
+            found = scheduler.attach(sid)
+            if found is not None:
+                break
+            if time.monotonic() >= wait_until or \
+                    deadline_expired(deadline):
+                return None
+            time.sleep(0.02)
+        kind, value = found
+        if kind == "finished":
+            return value
+        return value.result(self._result_timeout(deadline))
 
 
 class InferenceServer:
@@ -307,9 +539,12 @@ class InferenceServer:
     def __init__(self, models=None, registry=None, port=0,
                  host="127.0.0.1", request_timeout=60.0,
                  enable_admin=False, model_resolver=None,
-                 **scheduler_defaults):
+                 attach_wait=5.0, **scheduler_defaults):
         self.registry = registry or ModelRegistry(**scheduler_defaults)
         self.request_timeout = request_timeout
+        # how long an X-Veles-Attach follow waits for a migrated
+        # session's import to commit before answering 410
+        self.attach_wait = float(attach_wait)
         self.started = time.time()
         self.draining = False
         # the hot-load endpoint is opt-in (fleet replicas turn it on);
@@ -321,7 +556,11 @@ class InferenceServer:
             items = models.items() if hasattr(models, "items") else models
             for name, model in items:
                 self.registry.add(name, model)
-        handler = type("Handler", (_ServingHandler,), {"server_ref": self})
+        handler = type("Handler", (_ServingHandler,),
+                       {"server_ref": self,
+                        # the keep-alive reaper follows the configured
+                        # request timeout (was a hardcoded 60)
+                        "timeout": max(float(request_timeout), 1.0)})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         # in-flight handler threads are daemons; the graceful-drain
         # guarantee is the scheduler's (finish every queued request),

@@ -1,0 +1,62 @@
+"""Wire encoding for exported decode sessions.
+
+:meth:`DecodeScheduler.export_sessions` produces state dicts with
+numpy leaves (the prompt, the per-layer K/V block contents).  Between
+replicas they travel over the admin HTTP surface as JSON, so the
+arrays are framed as base64 raw bytes + dtype + shape — self-contained
+(no pickle: the peer is a different process trusting only structured
+data) and cheap relative to the device gather they carry.
+"""
+
+import base64
+
+import numpy
+
+__all__ = ["pack_state", "pack_states", "unpack_state", "unpack_states"]
+
+_ND = "__nd__"
+
+
+def _encode(value):
+    if isinstance(value, numpy.ndarray):
+        a = numpy.ascontiguousarray(value)
+        return {_ND: base64.b64encode(a.tobytes()).decode("ascii"),
+                "dtype": str(a.dtype), "shape": list(a.shape)}
+    if isinstance(value, (list, tuple)):
+        return [_encode(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _encode(v) for k, v in value.items()}
+    if isinstance(value, numpy.generic):
+        return value.item()
+    return value
+
+
+def _decode(value):
+    if isinstance(value, dict):
+        if _ND in value:
+            flat = numpy.frombuffer(
+                base64.b64decode(value[_ND]),
+                dtype=numpy.dtype(str(value["dtype"])))
+            return flat.reshape([int(d) for d in value["shape"]]).copy()
+        return {k: _decode(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_decode(v) for v in value]
+    return value
+
+
+def pack_state(state):
+    """One exported session state → a JSON-safe dict."""
+    return _encode(state)
+
+
+def unpack_state(payload):
+    """Inverse of :func:`pack_state` (arrays back to numpy)."""
+    return _decode(payload)
+
+
+def pack_states(states):
+    return [pack_state(s) for s in states]
+
+
+def unpack_states(payloads):
+    return [unpack_state(p) for p in payloads]

@@ -1,0 +1,165 @@
+"""A deterministic, KV-dependent decode stand-in for fleet drills.
+
+The fleet's ``sleep:`` stand-in pins device time per ROW so scheduling
+is measurable without XLA cost; this is its decode-path sibling.  Each
+next token is pure integer arithmetic over the **cached** K/V contents
+(gathered through the page table, masked by length — the same access
+pattern as the real ragged paged-attention kernel), so
+
+- generation is bitwise-deterministic and has a closed-form host
+  oracle (:meth:`ToyDecodeModel.generate_reference`) that any process
+  can compute without JAX — the cross-process token-identity check
+  behind the migration acceptance tests;
+- a wrong page table, a clobbered block, or a mis-restored length
+  CHANGES THE OUTPUT (a model that ignored its cache would hide
+  exactly the bugs session migration can introduce);
+- ``step_host_delay`` pins per-step wall time host-side (the
+  ``sleep:`` philosophy), giving chaos/migration drills a real
+  mid-generation window at zero compile cost.
+
+Every intermediate stays far below 2**31 for contexts up to thousands
+of tokens, so int32 device arithmetic and bignum host arithmetic agree
+exactly.
+
+Spec form (fleet replicas, ``--model NAME=SPEC``)::
+
+    toydecode:vocab=97,delay=0.02,max_batch=4,block=4,max_prompt=16,max_new=32
+"""
+
+__all__ = ["ToyDecodeModel", "from_spec"]
+
+#: mixing constants of the token recurrence (arbitrary small primes)
+_A, _B, _C, _D = 31, 7, 13, 17
+
+
+def _next_token(cache, last, vocab):
+    """The recurrence both the device decode step and the host oracle
+    compute: token = f(sum of cached K, sum of cached V, last fed
+    token, cache length)."""
+    s1 = sum(cache)
+    s2 = sum(3 * c + 1 for c in cache)
+    return (s1 * _A + s2 * _B + last * _C + len(cache) * _D) % vocab
+
+
+class ToyDecodeModel:
+    """Decode adapter (``make_pools``/``prefill_fn``/``decode_fn``)
+    whose K pool caches the token ids and whose V pool caches
+    ``3*token+1`` — the next token is a function of both sums, so the
+    output is a fingerprint of the cache contents."""
+
+    kind = "decode"
+
+    def __init__(self, vocab=97, step_delay=0.0, decode_defaults=None):
+        self.vocab = int(vocab)
+        if self.vocab < 2:
+            raise ValueError("vocab must be >= 2")
+        # honored by DecodeScheduler._step: host sleep per step
+        self.step_host_delay = float(step_delay)
+        # geometry the registry applies when serving this model
+        # (registry defaults < these < explicit kwargs)
+        self.decode_defaults = dict(decode_defaults or {})
+
+    def make_pools(self, num_blocks, block_size):
+        import jax.numpy as jnp
+        shape = (int(num_blocks), int(block_size))
+        return ((jnp.zeros(shape, jnp.int32),),
+                (jnp.zeros(shape, jnp.int32),))
+
+    def prefill_fn(self, block_size):
+        import jax.numpy as jnp
+        bs = int(block_size)
+        vocab = self.vocab
+
+        def prefill(tokens, length, k_pools, v_pools, block_row):
+            k, v = k_pools[0], v_pools[0]
+            pos = jnp.arange(tokens.shape[0], dtype=jnp.int32)
+            valid = pos < length
+            dest = jnp.where(valid, block_row[pos // bs], 0)
+            off = pos % bs
+            kv_k = jnp.where(valid, tokens, 0)
+            kv_v = jnp.where(valid, 3 * tokens + 1, 0)
+            k = k.at[dest, off].set(kv_k)
+            v = v.at[dest, off].set(kv_v)
+            s1 = jnp.sum(kv_k)
+            s2 = jnp.sum(kv_v)
+            last = tokens[jnp.maximum(length - 1, 0)]
+            first = (s1 * _A + s2 * _B + last * _C
+                     + length * _D) % vocab
+            return first.astype(jnp.int32), (k,), (v,)
+
+        return prefill
+
+    def decode_fn(self, block_size):
+        import jax.numpy as jnp
+        bs = int(block_size)
+        vocab = self.vocab
+
+        def decode(k_pools, v_pools, page_table, lengths, tokens):
+            k, v = k_pools[0], v_pools[0]
+            rows = jnp.arange(tokens.shape[0], dtype=jnp.int32)
+            # write the fed token at position `lengths` (padding rows
+            # have lengths 0 and table row 0 → the trash block)
+            dest = page_table[rows, lengths // bs]
+            off = lengths % bs
+            k = k.at[dest, off].set(tokens)
+            v = v.at[dest, off].set(3 * tokens + 1)
+            # gather each row's cache through ITS page table and mask
+            # by length — exactly the paged-attention access pattern
+            flat_k = k[page_table].reshape(tokens.shape[0], -1)
+            flat_v = v[page_table].reshape(tokens.shape[0], -1)
+            pos = jnp.arange(flat_k.shape[1], dtype=jnp.int32)[None, :]
+            count = lengths + 1          # the fed token is now cached
+            mask = pos < count[:, None]
+            s1 = jnp.sum(jnp.where(mask, flat_k, 0), axis=1)
+            s2 = jnp.sum(jnp.where(mask, flat_v, 0), axis=1)
+            nxt = (s1 * _A + s2 * _B + tokens * _C
+                   + count * _D) % vocab
+            return nxt.astype(jnp.int32), (k,), (v,)
+
+        return decode
+
+    def generate_reference(self, prompt, max_new_tokens):
+        """Cache-free host oracle: the tokens an uninterrupted
+        generation emits (pure python ints — usable cross-process
+        without JAX)."""
+        cache = [int(t) for t in prompt]
+        if not cache:
+            raise ValueError("empty prompt")
+        out = [_next_token(cache, cache[-1], self.vocab)]
+        while len(out) < int(max_new_tokens):
+            cache.append(out[-1])
+            out.append(_next_token(cache, out[-1], self.vocab))
+        return out
+
+    def __repr__(self):
+        return ("ToyDecodeModel(vocab=%d, step_delay=%s)"
+                % (self.vocab, self.step_host_delay))
+
+
+#: spec keys → DecodeScheduler geometry kwargs
+_GEOM_KEYS = {"max_batch": "max_batch", "block": "block_size",
+              "max_prompt": "max_prompt_len", "max_new": "max_new_tokens",
+              "num_blocks": "num_blocks", "queue_limit": "queue_limit"}
+
+
+def from_spec(spec):
+    """``toydecode:key=value,...`` → :class:`ToyDecodeModel` carrying
+    its scheduler geometry in ``decode_defaults`` (vocab/delay are
+    model knobs; the rest are geometry)."""
+    body = spec.partition(":")[2]
+    vocab, delay, defaults = 97, 0.0, {}
+    for part in filter(None, body.split(",")):
+        key, _, value = part.partition("=")
+        key = key.strip()
+        if key == "vocab":
+            vocab = int(value)
+        elif key == "delay":
+            delay = float(value)
+        elif key in _GEOM_KEYS:
+            defaults[_GEOM_KEYS[key]] = int(value)
+        else:
+            raise ValueError("unknown toydecode spec key %r (want "
+                             "vocab, delay, %s)"
+                             % (key, ", ".join(sorted(_GEOM_KEYS))))
+    return ToyDecodeModel(vocab=vocab, step_delay=delay,
+                          decode_defaults=defaults)
