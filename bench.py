@@ -436,29 +436,52 @@ def _stage_subprocess(stage, timeout):
     turn; (c) the parent stays JAX-free, so nothing can hang the
     orchestrator itself.  The child's in-process watchdog is set just
     under our kill timeout so a wedged child still emits its partial
-    JSON line first.  Returns (line_dict_or_None, error_or_None)."""
+    JSON line first.  Returns (line_dict_or_None, error_or_None).
+
+    The child runs in its OWN process group and a timeout kills the
+    whole group (killpg, then the child directly as a fallback):
+    ``subprocess.run(timeout=...)`` only signals the immediate child,
+    so a stage that forked helpers — or a child wedged un-SIGTERM-ably
+    inside a Pallas compile — used to leave grandchildren holding the
+    chip while the next stage started.  Same discipline as
+    ``veles_tpu.autotune.runner.run_isolated`` (inlined here so the
+    parent stays JAX-free: importing veles_tpu pulls in jax)."""
+    import signal
     import subprocess
     env = dict(os.environ)
     env["VELES_BENCH_WATCHDOG"] = str(max(60, int(timeout) - 45))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--stage", stage],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        start_new_session=True)
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__),
-             "--stage", stage],
-            capture_output=True, timeout=timeout, env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired as exc:
+        for kill in (lambda: os.killpg(os.getpgid(proc.pid),
+                                       signal.SIGKILL),
+                     proc.kill):
+            try:
+                kill()
+            except (OSError, ProcessLookupError):
+                pass
+        try:
+            proc.communicate(timeout=10)
+        except (subprocess.TimeoutExpired, OSError, ValueError):
+            pass
         line = _last_json_line((exc.stdout or b"").decode())
         return line, "stage %s timeout after %ds" % (stage, timeout)
-    line = _last_json_line(proc.stdout.decode())
+    line = _last_json_line(stdout.decode())
     if line is None:
         return None, "stage %s exit %d, no JSON: %s" % (
-            stage, proc.returncode, proc.stderr.decode()[-500:])
+            stage, proc.returncode, stderr.decode()[-500:])
     if proc.returncode:
         # keep BOTH the child's own error field and its stderr tail —
         # a crash after the result line printed is otherwise blank
         return line, "stage %s exit %d (partial kept): %s | stderr: %s" % (
             stage, proc.returncode, line.get("error", "")[:300],
-            proc.stderr.decode()[-300:])
+            stderr.decode()[-300:])
     return line, None
 
 
@@ -512,6 +535,8 @@ def bench_precise_gemm(n=4096, reps=8, repeats=6):
         "l2_overhead": round(res["level2"] / res["level0"], 3),
         "l0_vs_xla_default": round(res["level0"] / res["xla_default"],
                                    3),
+        "config": _autotune_provenance(
+            "precise_gemm", {"m": n, "k": n, "n": n, "level": 1}),
     }
 
 
@@ -544,7 +569,9 @@ def bench_flash_attention(b=2, t=2048, h=8, d=64, reps=8, chain=4):
     _record("attn_oracle_train", to)
     return {"flash_attention_train_s": round(min(ta), 5),
             "attention_oracle_train_s": round(min(to), 5),
-            "flash_attention_shape": [b, t, h, d]}
+            "flash_attention_shape": [b, t, h, d],
+            "flash_attention_config": _autotune_provenance(
+                "flash_attention", {"t": t, "d": d, "causal": True})}
 
 
 def bench_window_attention(b=1, t=16384, h=8, d=64, w=512, reps=6,
@@ -573,7 +600,10 @@ def bench_window_attention(b=1, t=16384, h=8, d=64, w=512, reps=6,
     _record("full_causal_train", tf)
     return {"window_attention_train_s": round(min(tw), 5),
             "full_causal_train_s": round(min(tf), 5),
-            "window_attention_shape": [b, t, h, d, w]}
+            "window_attention_shape": [b, t, h, d, w],
+            "window_attention_config": _autotune_provenance(
+                "window_attention",
+                {"t": t, "d": d, "causal": True, "window": w})}
 
 
 def bench_flagship(stages=4, experts=4, d=256, heads=8, hidden=1024,
@@ -649,7 +679,9 @@ def bench_serving(clients=8, seconds=2.0):
             "serve_post_warmup_compiles":
                 out.get("post_warmup_compiles"),
             "serve_time_to_first_response_s":
-                out.get("serve_time_to_first_response_s")}
+                out.get("serve_time_to_first_response_s"),
+            "serve_bucket_config": _autotune_provenance(
+                "serving.bucket_ladder", {"max_batch": 64})}
 
 
 def bench_cold_start(max_batch=16, probe_timeout=150):
@@ -762,7 +794,9 @@ def bench_decode(probe_timeout=240):
            "decode_cold_warmup_s": cold.get("decode_warmup_s"),
            "decode_warm_warmup_s": warm.get("decode_warmup_s"),
            "decode_warm_compiles": warm.get("decode_compiles"),
-           "decode_warm_cache_hits": warm.get("decode_cache_hits")}
+           "decode_warm_cache_hits": warm.get("decode_cache_hits"),
+           "decode_config": _autotune_provenance(
+               "serving.decode", {"max_context": 32})}
     return out
 
 
@@ -1189,6 +1223,129 @@ def bench_checkpoint(batch=512, steps=8, snaps=4, repeats=3):
     return out
 
 
+def _autotune_provenance(site, ctx, default=None):
+    """What the tuning store resolved for this stage's kernel shape:
+    flat config + ``config_source: "tuned"|"default"`` — every kernel
+    metric names the config that produced it (ISSUE 13 satellite).
+    Provenance must never fail a measurement."""
+    try:
+        from veles_tpu.autotune import describe
+        from veles_tpu.autotune.space import site as _site
+        sp = _site(site)
+        return describe(site, sp.shape_class(ctx),
+                        default if default is not None
+                        else dict(sp.default))
+    except Exception as exc:            # noqa: BLE001
+        return {"config_source": "error: %s" % exc}
+
+
+def bench_autotune(probe_timeout=90):
+    """Persistent kernel/serving config tuning (ISSUE 13).
+
+    (a) CPU end-to-end roundtrip across TWO fresh processes: the first
+    tunes a tiny LRN site into a scratch store (every candidate its own
+    gated subprocess), the second resolves the persisted winner off
+    disk — asserting source == "tuned", the exact stored config, and a
+    byte-untouched store (zero re-measurement on warm restart).
+
+    (b) on-device tuning of the shapes the LATER stages dispatch (the
+    AlexNet LRN classes, the paged decode kernel, the serving bucket
+    ladder) into the shared ``$VELES_AUTOTUNE_DIR`` the orchestrator
+    exports to every stage child — so ``pallas_lrn`` & co. resolve
+    measured winners instead of hand-picks.  Budget-aware: sites are
+    skipped, never truncated mid-measurement."""
+    import subprocess
+    import tempfile
+    _stamp("autotune stage")
+    stage_t0 = time.perf_counter()
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tool = os.path.join(repo, "tools", "autotune.py")
+    out = {}
+
+    # -- (a) cross-process roundtrip: tune, restart, resolve ----------
+    scratch = tempfile.mkdtemp(prefix="veles-autotune-rt-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("VELES_AUTOTUNE_DIR", None)   # the roundtrip owns its store
+    t0 = time.perf_counter()
+    p1 = subprocess.run(
+        [sys.executable, tool, "tune", "--dir", scratch, "--site",
+         "lrn", "--ctx", json.dumps({"rows": 256, "c": 32, "n": 5}),
+         "--json", "--timeout", "60"],
+        capture_output=True, timeout=max(4 * probe_timeout, 300),
+        env=env, cwd=repo)
+    tune_s = time.perf_counter() - t0
+    try:
+        winner = json.loads(p1.stdout.decode())["tuned"][0]
+    except (ValueError, LookupError):
+        raise RuntimeError("autotune roundtrip tune failed: %s"
+                           % p1.stderr.decode()[-400:])
+
+    def _store_state():
+        return sorted(
+            (f, os.path.getmtime(os.path.join(scratch, f)),
+             os.path.getsize(os.path.join(scratch, f)))
+            for f in os.listdir(scratch))
+
+    before = _store_state()
+    p2 = subprocess.run(
+        [sys.executable, tool, "resolve", "--dir", scratch, "--site",
+         "lrn", "--shape", winner["shape_class"]],
+        capture_output=True, timeout=probe_timeout, env=env, cwd=repo)
+    res = _last_json_line(p2.stdout.decode()) or {}
+    untouched = _store_state() == before
+    ok = (res.get("config_source") == "tuned"
+          and res.get("config") == winner["config"] and untouched)
+    out["autotune_roundtrip_ok"] = bool(ok)
+    out["autotune_roundtrip_speedup"] = winner.get("speedup")
+    out["autotune_roundtrip_winner"] = winner.get("config")
+    out["autotune_roundtrip_tune_s"] = round(tune_s, 2)
+    if not ok:
+        out["autotune_roundtrip_detail"] = (
+            "source=%r config_equal=%r store_untouched=%r"
+            % (res.get("config_source"),
+               res.get("config") == winner.get("config"), untouched))
+
+    # -- (b) tune what the later kernel stages will dispatch ----------
+    tune_dir = os.environ.get("VELES_AUTOTUNE_DIR")
+    if not tune_dir:
+        return out
+    from veles_tpu.autotune.runner import tune_site
+    from veles_tpu.autotune.store import TuningStore
+    store = TuningStore(tune_dir)
+    budget = float(os.environ.get("VELES_BENCH_WATCHDOG", 360)) - 45
+    # LRN first (it feeds the pallas_lrn_speedup acceptance); the
+    # serving ladder and the paged decode kernel after; the second LRN
+    # class last (same kernel, diminishing returns if budget is tight)
+    plan = [
+        ("lrn", {"rows": 2048, "c": 96, "n": 5}),
+        ("serving.bucket_ladder", {"max_batch": 16, "dim": 64,
+                                   "requests": 48}),
+        ("paged_attention", {"batch": 2, "heads": 2, "d": 16,
+                             "length": 48}),
+        ("lrn", {"rows": 2048, "c": 256, "n": 5}),
+    ]
+    tuned, skipped = {}, []
+    for site_name, ctx in plan:
+        left = budget - (time.perf_counter() - stage_t0)
+        if left < 2.5 * probe_timeout:
+            skipped.append(site_name)
+            continue
+        try:
+            rec = tune_site(site_name, ctx or None, store=store,
+                            timeout=probe_timeout, log_fn=_stamp)
+        except Exception as exc:        # noqa: BLE001 — keep tuning
+            tuned["%s!error" % site_name] = str(exc)[:200]
+            continue
+        if rec is not None:
+            tuned["%s/%s" % (site_name, rec["shape_class"])] = {
+                "config": rec["config"],
+                "speedup": rec["speedup"], "gate": rec["gate"]}
+    out["autotune_tuned"] = tuned
+    if skipped:
+        out["autotune_skipped"] = skipped
+    return out
+
+
 def bench_liveness():
     """Stage 0 gate: one tiny jitted matmul with a real D2H flush.  If
     THIS can't finish, the tunnel is down and the orchestrator reports
@@ -1230,7 +1387,13 @@ def _stage_main(stage):
     elif stage == "pallas_lrn":
         ips = bench_alexnet_scan(batch=BATCH, use_pallas_lrn=True,
                                  repeats=3, name="alexnet_pallas_lrn")
-        out = {"pallas_lrn_images_per_sec": round(ips, 1)}
+        out = {"pallas_lrn_images_per_sec": round(ips, 1),
+               "pallas_lrn_config": {
+                   cls: _autotune_provenance(
+                       "lrn", {"c": c, "n": 5, "rows": 2048})
+                   for cls, c in (("c96_n5", 96), ("c256_n5", 256))}}
+    elif stage == "autotune":
+        out = bench_autotune()
     elif stage == "precise_gemm":
         out = {"precise_gemm": bench_precise_gemm()}
     elif stage == "serving":
@@ -1274,6 +1437,11 @@ STAGE_PLAN = [
     # compile can take minutes — don't let the cap kill the round's
     # hand-kernel metric mid-compile
     ("flash_attention", 420),
+    # the tuner runs BEFORE the kernel stages it feeds: winners land in
+    # the shared $VELES_AUTOTUNE_DIR, so pallas_lrn below dispatches
+    # measured configs.  Also proves the cross-process roundtrip on CPU
+    # (tune in one process, resolve untouched in a second)
+    ("autotune", 420),
     # pallas_lrn runs the SAME 32-epoch scan depth as the headline (a
     # mixed-depth ratio would understate the kernel by the ~19 %
     # dispatch amortization), so its compile+timed block needs more cap
@@ -1339,6 +1507,13 @@ def _orchestrate():
     # even if that means skipping the trailing optional stages
     budget = float(os.environ.get("VELES_BENCH_BUDGET", 1700))
     deadline = time.perf_counter() + budget
+    if not os.environ.get("VELES_AUTOTUNE_DIR"):
+        # one shared tuning store for the whole round: the autotune
+        # stage writes winners here, every later stage child inherits
+        # the env and dispatches them
+        import tempfile
+        os.environ["VELES_AUTOTUNE_DIR"] = tempfile.mkdtemp(
+            prefix="veles-bench-autotune-")
     results, errors = {}, {}
     for stage, cap in STAGE_PLAN:
         remaining = deadline - time.perf_counter()

@@ -1,0 +1,589 @@
+"""veles_tpu.autotune: persistent search over kernel/serving configs
+(ISSUE 13).
+
+The contract under test: with the tuner OFF every site runs its
+hand-picked config byte-for-byte; a tuning record for the current
+(site, shape class, device kind, jax/jaxlib versions) redirects
+dispatch to the measured winner; a corrupt record quarantines, falls
+back to the default and warns exactly once; a version drift is a clean
+miss (never a misload, never a quarantine); a fast-but-wrong candidate
+can never win (correctness gate); probe subprocesses die as a whole
+process group at the wall-clock cap; and winners persist across real
+process restarts with zero re-measurement.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from veles_tpu.autotune import dispatch, runner, space, store  # noqa: E402
+from veles_tpu.compilecache import keys as keys_mod            # noqa: E402
+from veles_tpu.config import root                              # noqa: E402
+from veles_tpu.observability.registry import REGISTRY          # noqa: E402
+
+
+@pytest.fixture
+def tune_dir(tmp_path):
+    """A tuning store wired into config, torn back down after."""
+    d = str(tmp_path / "autotune")
+    prior = root.common.autotune.get("dir", None)
+    root.common.autotune.dir = d
+    dispatch.reset_default_stores()
+    try:
+        yield d
+    finally:
+        root.common.autotune.dir = prior
+        dispatch.reset_default_stores()
+
+
+def _counter(name):
+    metric = REGISTRY.get(name)
+    return metric.value if metric is not None else 0.0
+
+
+def _put_lrn(st, shape_class="c96_n5", impl="mxu", block_rows=1024):
+    return st.put("lrn", shape_class,
+                  {"impl": impl, "block_rows": block_rows},
+                  default={"impl": "pallas", "block_rows": 1024},
+                  speedup=1.3, baseline_s=1e-3, best_s=8e-4,
+                  candidates_tried=4)
+
+
+# -- search space -------------------------------------------------------------
+
+def test_every_site_default_is_its_own_first_candidate():
+    ctxs = {"lrn": {"rows": 2048, "c": 96, "n": 5},
+            "flash_attention": {"t": 1024, "d": 64, "causal": True},
+            "window_attention": {"t": 1024, "d": 64, "window": 256},
+            "precise_gemm": {"m": 512, "k": 512, "n": 512, "level": 1},
+            "paged_attention": {"batch": 2, "heads": 2, "d": 16,
+                                "length": 48},
+            "serving.bucket_ladder": {"max_batch": 16},
+            "serving.decode": {"max_context": 64}}
+    assert set(ctxs) == set(space.SITES)
+    for name, ctx in ctxs.items():
+        sp = space.site(name)
+        cands = sp.candidates(ctx)
+        assert cands[0] == sp.default, name
+        assert len(cands) == len({json.dumps(c, sort_keys=True)
+                                  for c in cands}), name  # deduped
+        for c in cands:
+            assert sp.valid(c, ctx), (name, c)
+
+
+def test_space_defaults_match_kernel_constants():
+    """The declared defaults ARE the hand-picked constants — if a
+    kernel's default drifts, the tuner-off path would silently change."""
+    from veles_tpu.znicz import flash_attention as fa
+    from veles_tpu.znicz import gemm
+    from veles_tpu.znicz import lrn
+    from veles_tpu.znicz import paged_attention as pa
+    assert space.site("lrn").default == {
+        "impl": "pallas", "block_rows": lrn._LRN_BLOCK_ROWS}
+    assert space.site("flash_attention").default == {
+        "block_q": fa.DEFAULT_BLOCK_Q, "block_k": fa.DEFAULT_BLOCK_K}
+    assert space.site("precise_gemm").default == {
+        "block_m": gemm.DEFAULT_BLOCK_M, "block_n": gemm.DEFAULT_BLOCK_N,
+        "block_k": gemm.DEFAULT_BLOCK_K}
+    assert space.site("paged_attention").default == {
+        "block_size": pa.DEFAULT_BLOCK_SIZE}
+    assert space.site("serving.decode").default == {
+        "max_batch": 8, "block_size": pa.DEFAULT_BLOCK_SIZE}
+
+
+def test_ladder_pow2_is_byte_identical_to_bucket_sizes():
+    from veles_tpu.serving.scheduler import bucket_sizes
+    for mb in (1, 2, 3, 8, 16, 48, 64, 100):
+        assert space.ladder("pow2", mb) == bucket_sizes(mb), mb
+
+
+def test_ladder_shapes_end_at_max_batch_and_start_at_one():
+    for shape in ("pow2", "coarse", "dense"):
+        for mb in (1, 4, 16, 64):
+            sizes = space.ladder(shape, mb)
+            assert sizes[0] == 1 and sizes[-1] == mb, (shape, mb)
+            assert sizes == sorted(set(sizes))
+
+
+def test_constraints_filter_invalid_candidates():
+    # flash blocks must divide T
+    for c in space.site("flash_attention").candidates(
+            {"t": 384, "d": 64, "causal": True})[1:]:
+        assert 384 % c["block_q"] == 0 and 384 % c["block_k"] == 0
+    # gemm tiles must fit the VMEM budget
+    for c in space.site("precise_gemm").candidates(
+            {"m": 4096, "k": 4096, "n": 4096, "level": 1}):
+        bm, bn, bk = c["block_m"], c["block_n"], c["block_k"]
+        assert (bm * bk + bk * bn + 4 * bm * bn) * 4 <= (12 << 20)
+
+
+# -- store --------------------------------------------------------------------
+
+def test_store_roundtrip_schema_and_provenance(tmp_path):
+    st = store.TuningStore(str(tmp_path))
+    rec = _put_lrn(st)
+    got = st.get("lrn", "c96_n5")
+    assert got == rec
+    for field in store._REQUIRED:
+        assert field in got, field
+    assert got["schema"] == store.SCHEMA
+    assert got["fingerprint"] == store.environment_fingerprint()
+    # per-record provenance the CLI surfaces
+    assert got["jax"] != "?" and got["device_kind"] != "?"
+    # no tmp litter (atomic rename)
+    assert all(not f.endswith(".tmp") and ".tmp." not in f
+               for f in os.listdir(str(tmp_path)))
+
+
+def test_corrupt_record_quarantines_falls_back_and_warns_once(
+        tmp_path, caplog):
+    st = store.TuningStore(str(tmp_path))
+    _put_lrn(st)
+    key = store.record_key("lrn", "c96_n5")
+    path = st.path_for(key)
+    with open(path, "w") as f:
+        f.write("{ not json")
+    corrupt_before = _counter("veles_autotune_corrupt_total")
+    with caplog.at_level("WARNING", logger="veles_tpu.autotune"):
+        assert st.get("lrn", "c96_n5") is None     # fallback, no crash
+        assert st.get("lrn", "c96_n5") is None     # second read: quiet
+    warnings = [r for r in caplog.records if "corrupt" in r.message]
+    assert len(warnings) == 1
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")       # forensics kept
+    assert _counter("veles_autotune_corrupt_total") == corrupt_before + 1
+
+
+def test_wrong_identity_fields_are_rejected(tmp_path):
+    """A record whose body disagrees with its key (site/shape/
+    fingerprint) must never be served — same cross-check as the
+    compilecache entry key."""
+    st = store.TuningStore(str(tmp_path))
+    rec = _put_lrn(st)
+    forged = dict(rec, site="flash_attention")
+    key = store.record_key("lrn", "c96_n5")
+    with open(st.path_for(key), "w") as f:
+        json.dump(forged, f)
+    assert st.get("lrn", "c96_n5") is None
+
+
+def test_version_drift_is_clean_miss_never_misload(
+        tmp_path, monkeypatch):
+    st = store.TuningStore(str(tmp_path))
+    _put_lrn(st)
+    assert st.get("lrn", "c96_n5") is not None
+    monkeypatch.setattr(keys_mod, "environment_fingerprint",
+                        lambda: "jax=9.9.9;jaxlib=9.9.9;platform=tpu;"
+                                "device_kind=TPU v9;devices=1")
+    # the drifted environment hashes to a different key: miss, re-tune
+    assert st.get("lrn", "c96_n5") is None
+    # and NOTHING was quarantined — the old record stays valid for the
+    # old environment
+    assert not any(f.endswith(".corrupt")
+                   for f in os.listdir(str(tmp_path)))
+    monkeypatch.undo()
+    assert st.get("lrn", "c96_n5") is not None
+
+
+def test_store_records_lists_corrupt_without_quarantining(tmp_path):
+    st = store.TuningStore(str(tmp_path))
+    _put_lrn(st)
+    key = store.record_key("lrn", "c96_n5")
+    with open(st.path_for(key), "w") as f:
+        f.write("garbage")
+    rows = st.records()
+    assert len(rows) == 1
+    k, rec, reason = rows[0]
+    assert k == key and rec is None and reason
+    assert os.path.exists(st.path_for(key))        # read-only surface
+
+
+# -- dispatch -----------------------------------------------------------------
+
+def test_tuner_off_returns_exact_default_no_disk_access(tmp_path):
+    prior = root.common.autotune.get("dir", None)
+    prior_env = os.environ.pop(dispatch.AUTOTUNE_DIR_ENV, None)
+    try:
+        root.common.autotune.dir = None
+        dispatch.reset_default_stores()
+        default = {"impl": "pallas", "block_rows": 1024}
+        cfg, src = dispatch.resolve("lrn", "c96_n5", default=default)
+        assert src == "default" and cfg == default
+        assert cfg is not default                  # mutation-safe copy
+    finally:
+        root.common.autotune.dir = prior
+        if prior_env is not None:
+            os.environ[dispatch.AUTOTUNE_DIR_ENV] = prior_env
+        dispatch.reset_default_stores()
+
+
+def test_enabled_false_overrides_configured_dir(tune_dir):
+    _put_lrn(store.TuningStore(tune_dir))
+    prior = root.common.autotune.get("enabled", True)
+    try:
+        root.common.autotune.enabled = False
+        dispatch.reset_default_stores()
+        cfg, src = dispatch.resolve(
+            "lrn", "c96_n5",
+            default={"impl": "pallas", "block_rows": 1024})
+        assert src == "default" and cfg["impl"] == "pallas"
+    finally:
+        root.common.autotune.enabled = prior
+        dispatch.reset_default_stores()
+
+
+def test_tuned_record_resolves_and_counts(tune_dir):
+    _put_lrn(store.TuningStore(tune_dir))
+    hits = _counter("veles_autotune_tuned_hits_total")
+    cfg, src = dispatch.resolve(
+        "lrn", "c96_n5", default={"impl": "pallas", "block_rows": 1024})
+    assert src == "tuned" and cfg["impl"] == "mxu"
+    assert _counter("veles_autotune_tuned_hits_total") == hits + 1
+    # memoized: a second resolve is free (no counter bump)
+    dispatch.resolve("lrn", "c96_n5",
+                     default={"impl": "pallas", "block_rows": 1024})
+    assert _counter("veles_autotune_tuned_hits_total") == hits + 1
+
+
+def test_miss_counts_fallback_and_merges_grown_params(tune_dir):
+    st = store.TuningStore(tune_dir)
+    st.put("flash_attention", "t1024_d64_causal", {"block_q": 512},
+           default={"block_q": 256, "block_k": 256}, speedup=1.1)
+    falls = _counter("veles_autotune_fallbacks_total")
+    cfg, src = dispatch.resolve(
+        "flash_attention", "t2048_d64_causal",          # no record
+        default={"block_q": 256, "block_k": 256})
+    assert src == "default"
+    assert _counter("veles_autotune_fallbacks_total") == falls + 1
+    # a record written before the space grew a param: missing keys
+    # take the default instead of KeyErroring at the kernel
+    cfg, src = dispatch.resolve(
+        "flash_attention", "t1024_d64_causal",
+        default={"block_q": 256, "block_k": 256})
+    assert src == "tuned"
+    assert cfg == {"block_q": 512, "block_k": 256}
+
+
+def test_lrn_unit_dispatches_tuned_impl_and_reverts_when_off(tune_dir):
+    import jax.numpy as jnp
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.znicz.lrn import (LRNormalizerForward, lrn_mxu,
+                                     pallas_lrn)
+    x = jnp.asarray(numpy.random.RandomState(0)
+                    .randn(32, 96).astype(numpy.float32))
+    want_mxu = lrn_mxu(x, 5, 1e-4, 0.75, 2.0)
+    want_pallas = pallas_lrn(x, 5, 1e-4, 0.75, 2.0)
+    _put_lrn(store.TuningStore(tune_dir))
+    dispatch.reset_default_stores()
+    wf = Workflow(None)
+    unit = LRNormalizerForward(wf, use_pallas=True)
+    out = unit.apply({}, x)
+    assert unit.config_source == "tuned"
+    assert float(jnp.max(jnp.abs(out - want_mxu))) == 0.0
+    # tuner off: byte-for-byte the hand-picked Pallas kernel
+    prior = root.common.autotune.get("dir", None)
+    try:
+        root.common.autotune.dir = None
+        dispatch.reset_default_stores()
+        unit2 = LRNormalizerForward(wf, use_pallas=True)
+        out2 = unit2.apply({}, x)
+        assert unit2.config_source == "default"
+        assert float(jnp.max(jnp.abs(out2 - want_pallas))) == 0.0
+    finally:
+        root.common.autotune.dir = prior
+        dispatch.reset_default_stores()
+
+
+# -- runner -------------------------------------------------------------------
+
+def test_fast_but_wrong_candidate_can_never_win(tune_dir):
+    """The correctness gate outranks speed: a candidate 100x faster
+    with a failed gate is discarded."""
+    def fake_measure(site, config, ctx):
+        if config["impl"] == "mxu":
+            return {"ok": True, "config": config, "gate":
+                    "failed (err=1.0e+00)", "score": 0.01,
+                    "cand_s": 1e-6, "ref_s": 1e-4}
+        return {"ok": True, "config": config, "gate": "passed",
+                "score": 1.0 if config["block_rows"] == 1024 else 0.9,
+                "cand_s": 1e-4, "ref_s": 1e-4}
+    gate_failures = _counter("veles_autotune_gate_failures_total")
+    rec = runner.tune_site("lrn", {"rows": 2048, "c": 96, "n": 5},
+                           store=store.TuningStore(tune_dir),
+                           measure=fake_measure)
+    assert rec["config"]["impl"] == "pallas"        # gated winner only
+    assert rec["gate"] == "passed"
+    assert _counter("veles_autotune_gate_failures_total") > gate_failures
+
+
+def test_no_viable_candidate_keeps_default(tune_dir):
+    rec = runner.tune_site(
+        "lrn", {"rows": 2048, "c": 96, "n": 5},
+        store=store.TuningStore(tune_dir),
+        measure=lambda s, c, x: {"ok": False, "error": "boom"})
+    assert rec is None
+    assert store.TuningStore(tune_dir).get("lrn", "c96_n5") is None
+    cfg, src = dispatch.resolve(
+        "lrn", "c96_n5", default={"impl": "pallas", "block_rows": 1024})
+    assert src == "default"
+
+
+def test_speedup_is_relative_to_default_candidate(tune_dir):
+    """Sites whose probe reference is an oracle (not the default
+    config) still record speedup vs the HAND-PICKED default."""
+    def fake_measure(site, config, ctx):
+        # all scores vs a fixed oracle: default 2.0, winner 1.0
+        score = 1.0 if config["block_q"] == 512 else 2.0
+        return {"ok": True, "config": config, "gate": "passed",
+                "score": score, "cand_s": score * 1e-4, "ref_s": 1e-4}
+    rec = runner.tune_site("flash_attention",
+                           {"t": 1024, "d": 64, "causal": True},
+                           store=store.TuningStore(tune_dir),
+                           measure=fake_measure)
+    assert rec["config"]["block_q"] == 512
+    assert rec["speedup"] == pytest.approx(2.0)
+
+
+def test_run_isolated_kills_whole_process_group(tmp_path):
+    """A probe that spawns a grandchild and hangs: the hard cap kills
+    BOTH (killpg), not just the immediate child."""
+    pidfile = str(tmp_path / "grandchild.pid")
+    script = textwrap.dedent("""
+        import os, subprocess, sys, time
+        p = subprocess.Popen([sys.executable, "-c",
+                              "import time; time.sleep(600)"])
+        open(%r, "w").write(str(p.pid))
+        time.sleep(600)
+    """ % pidfile)
+    t0 = time.perf_counter()
+    rc, out, err, timed_out = runner.run_isolated(
+        [sys.executable, "-c", script], timeout=3.0)
+    assert timed_out
+    assert time.perf_counter() - t0 < 30
+    deadline = time.time() + 10
+    gpid = int(open(pidfile).read())
+    while time.time() < deadline:
+        try:
+            os.kill(gpid, 0)                       # still alive?
+        except ProcessLookupError:
+            break                                  # grandchild dead
+        time.sleep(0.2)
+    else:
+        os.kill(gpid, 9)
+        pytest.fail("grandchild outlived the process-group kill")
+
+
+def test_real_subprocess_lrn_tune_end_to_end(tune_dir):
+    """The whole pipeline, no injection: fresh-subprocess probes, gate,
+    persist — tiny rows so only {default, mxu} are candidates."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(dispatch.AUTOTUNE_DIR_ENV, None)
+    st = store.TuningStore(tune_dir)
+    rec = runner.tune_site("lrn", {"rows": 64, "c": 8, "n": 3},
+                           store=st, timeout=90, env=env)
+    assert rec is not None and rec["gate"] == "passed"
+    assert st.get("lrn", "c8_n3")["config"] == rec["config"]
+    assert rec["candidates_tried"] >= 2
+
+
+# -- serving integration ------------------------------------------------------
+
+def test_scheduler_resolves_tuned_ladder_and_records_manifest(
+        tune_dir, tmp_path):
+    from veles_tpu.compilecache.manifest import WarmupManifest
+    from veles_tpu.serving.scheduler import BucketScheduler
+    st = store.TuningStore(tune_dir)
+    st.put("serving.bucket_ladder", "mb16", {"shape": "coarse"},
+           default={"shape": "pow2"}, speedup=1.2)
+    dispatch.reset_default_stores()
+    manifest = str(tmp_path / "manifest.json")
+    s = BucketScheduler(lambda x: x * 2.0, max_batch=16,
+                        sample_shape=(4,), cache=False,
+                        manifest=manifest, warmup=True)
+    try:
+        assert s.config_source == "tuned"
+        assert s.buckets == space.ladder("coarse", 16) == [1, 4, 8, 16]
+        out = s.submit(numpy.ones((3, 4), numpy.float32)).result(30)
+        assert numpy.allclose(out, 2.0)
+        assert s.stats()["bucket_config"]["config_source"] == "tuned"
+    finally:
+        s.close()
+    cfg = WarmupManifest(manifest).configs("default")
+    assert cfg["serving.bucket_ladder"]["buckets"] == [1, 4, 8, 16]
+
+
+def test_scheduler_explicit_buckets_and_off_path(tune_dir):
+    from veles_tpu.serving.scheduler import BucketScheduler, bucket_sizes
+    s = BucketScheduler(lambda x: x + 1.0, max_batch=8,
+                        sample_shape=(4,), cache=False, warmup=False,
+                        buckets=[1, 8])
+    assert s.config_source == "explicit" and s.buckets == [1, 8]
+    s.close()
+    with pytest.raises(ValueError):
+        BucketScheduler(lambda x: x, max_batch=8, sample_shape=(4,),
+                        cache=False, warmup=False, buckets=[2, 4])
+    prior = root.common.autotune.get("dir", None)
+    try:
+        root.common.autotune.dir = None
+        dispatch.reset_default_stores()
+        s2 = BucketScheduler(lambda x: x + 1.0, max_batch=8,
+                             sample_shape=(4,), cache=False,
+                             warmup=False)
+        assert s2.config_source == "default"
+        assert s2.buckets == bucket_sizes(8)
+        s2.close()
+    finally:
+        root.common.autotune.dir = prior
+        dispatch.reset_default_stores()
+
+
+def test_decode_scheduler_tuned_explicit_and_off_geometry(tune_dir):
+    from veles_tpu.serving.decode import DecodeScheduler
+    from veles_tpu.znicz.samples.flagship import FlagshipDecodeModel
+    model = FlagshipDecodeModel(stages=2, experts=2, d=16, heads=2,
+                                hidden=32, vocab=32, seed=0)
+    st = store.TuningStore(tune_dir)
+    st.put("serving.decode", "ctx16", {"max_batch": 4, "block_size": 4},
+           default={"max_batch": 8, "block_size": 8}, speedup=1.2)
+    dispatch.reset_default_stores()
+    s = DecodeScheduler(model, max_prompt_len=8, max_new_tokens=8,
+                        cache=False, warmup=False)
+    assert s.config_source == "tuned"
+    assert (s.max_batch, s.block_size) == (4, 4)
+    assert s.stats()["config_source"] == "tuned"
+    s.close()
+    # explicit kwargs pin the geometry, record or not
+    s2 = DecodeScheduler(model, max_batch=2, block_size=8,
+                         max_prompt_len=8, max_new_tokens=8,
+                         cache=False, warmup=False)
+    assert s2.config_source == "explicit"
+    assert (s2.max_batch, s2.block_size) == (2, 8)
+    s2.close()
+    prior = root.common.autotune.get("dir", None)
+    try:
+        root.common.autotune.dir = None
+        dispatch.reset_default_stores()
+        s3 = DecodeScheduler(model, max_prompt_len=8, max_new_tokens=8,
+                             cache=False, warmup=False)
+        assert s3.config_source == "default"
+        assert (s3.max_batch, s3.block_size) == (8, 8)   # historical
+        s3.close()
+    finally:
+        root.common.autotune.dir = prior
+        dispatch.reset_default_stores()
+
+
+def test_manifest_configs_roundtrip_and_backward_compat(tmp_path):
+    from veles_tpu.compilecache.manifest import WarmupManifest
+    path = str(tmp_path / "m.json")
+    m = WarmupManifest(path)
+    m.record("mdl", 4)
+    assert m.record_config("mdl", "serving.bucket_ladder",
+                           {"shape": "coarse", "buckets": [1, 4]})
+    assert not m.record_config("mdl", "serving.bucket_ladder",
+                               {"shape": "coarse", "buckets": [1, 4]})
+    again = WarmupManifest(path)
+    assert again.buckets("mdl") == [4]
+    assert again.configs("mdl") == {
+        "serving.bucket_ladder": {"shape": "coarse", "buckets": [1, 4]}}
+    # an old-format manifest (no "configs" key) still loads
+    with open(path, "w") as f:
+        json.dump({"models": {"mdl": [{"bucket": 2}]}}, f)
+    old = WarmupManifest(path)
+    assert old.buckets("mdl") == [2] and old.configs("mdl") == {}
+    assert old.forget("mdl")
+
+
+def test_inject_env_forwards_autotune_dir(tmp_path):
+    from veles_tpu import compilecache as cc
+    prior = root.common.autotune.get("dir", None)
+    prior_cc = root.common.compile_cache.get("dir", None)
+    try:
+        root.common.compile_cache.dir = None
+        root.common.autotune.dir = str(tmp_path / "tune")
+        env = cc.inject_env({})
+        assert env["VELES_AUTOTUNE_DIR"] == \
+            os.path.abspath(str(tmp_path / "tune"))
+    finally:
+        root.common.autotune.dir = prior
+        root.common.compile_cache.dir = prior_cc
+
+
+# -- CLI + cross-process ------------------------------------------------------
+
+def test_cli_list_show_verify_and_corrupt_exit_code(tune_dir):
+    _put_lrn(store.TuningStore(tune_dir))
+    tool = os.path.join(REPO, "tools", "autotune.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(*args):
+        return subprocess.run([sys.executable, tool] + list(args),
+                              capture_output=True, text=True,
+                              timeout=120, env=env, cwd=REPO)
+
+    p = run("list", "--dir", tune_dir, "--json")
+    assert p.returncode == 0, p.stderr[-500:]
+    rows = json.loads(p.stdout)
+    assert len(rows) == 1 and rows[0]["record"]["site"] == "lrn"
+    p = run("show", "--dir", tune_dir, "--site", "lrn",
+            "--shape", "c96_n5", "--json")
+    assert p.returncode == 0
+    assert json.loads(p.stdout)["config"]["impl"] == "mxu"
+    p = run("verify", "--dir", tune_dir)
+    assert p.returncode == 0
+    key = store.record_key("lrn", "c96_n5")
+    with open(os.path.join(tune_dir, key + store.SUFFIX), "w") as f:
+        f.write("junk")
+    p = run("verify", "--dir", tune_dir)
+    assert p.returncode == 1 and "CORRUPT" in p.stdout
+
+
+def test_cross_process_resolution_zero_new_compiles(tune_dir, tmp_path):
+    """The warm-restart acceptance: a pre-tuned ladder + a warm
+    executable cache mean a SECOND process resolves the tuned geometry
+    off disk (no re-measurement — the store is byte-untouched) and
+    compiles NOTHING."""
+    from tools.serve_bench import build_mnist_package
+    package = build_mnist_package(str(tmp_path / "pkg.zip"))
+    cache_dir = str(tmp_path / "cc")
+    st = store.TuningStore(tune_dir)
+    st.put("serving.bucket_ladder", "mb16", {"shape": "coarse"},
+           default={"shape": "pow2"}, speedup=1.2)
+    tool = os.path.join(REPO, "tools", "cold_start.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(dispatch.AUTOTUNE_DIR_ENV, None)
+
+    def state():
+        return sorted((f, os.path.getmtime(os.path.join(tune_dir, f)))
+                      for f in os.listdir(tune_dir))
+
+    def probe():
+        proc = subprocess.run(
+            [sys.executable, tool, "--phase", "serving",
+             "--package", package, "--max-batch", "16",
+             "--cache-dir", cache_dir, "--autotune-dir", tune_dir],
+            capture_output=True, text=True, timeout=180, env=env,
+            cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    before = state()
+    cold = probe()
+    assert cold["bucket_config"]["config_source"] == "tuned"
+    assert cold["buckets"] == [1, 4, 8, 16]        # the coarse ladder
+    assert cold["compiles"] == 4 and cold["cache_hits"] == 0
+    warm = probe()
+    assert warm["bucket_config"]["config_source"] == "tuned"
+    assert warm["buckets"] == [1, 4, 8, 16]
+    assert warm["compiles"] == 0                   # zero new XLA work
+    assert warm["cache_hits"] == 4
+    assert state() == before                       # zero re-measurement

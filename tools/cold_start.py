@@ -69,6 +69,7 @@ def probe_serving(package, max_batch):
             "compiles": stats["compiles"],
             "cache_hits": stats["cache_hits"],
             "buckets": stats["buckets"],
+            "bucket_config": stats["bucket_config"],
             "output_rows": int(numpy.asarray(out).shape[0])}
 
 
@@ -112,6 +113,10 @@ def main(argv=None):
     p.add_argument("--cache-dir", default=None,
                    help="enable the persistent executable cache here "
                         "(default: off — seed behavior)")
+    p.add_argument("--autotune-dir", default=None,
+                   help="resolve kernel/serving configs through this "
+                        "tuning store (default: off — hand-picked "
+                        "defaults)")
     p.add_argument("--package", default=None,
                    help="exported package zip for --phase serving "
                         "(default: build an initialized MNIST package)")
@@ -123,6 +128,8 @@ def main(argv=None):
     import_s = time.perf_counter() - t0 + (t0 - _T0)
     if args.cache_dir:
         root.common.compile_cache.dir = args.cache_dir
+    if args.autotune_dir:
+        root.common.autotune.dir = args.autotune_dir
 
     if args.phase == "serving":
         package = args.package

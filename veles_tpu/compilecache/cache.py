@@ -206,7 +206,11 @@ def inject_env(env=None):
     JAX persistent compilation cache dir when set."""
     directory, max_bytes = resolve_config()
     jax_cc = root.common.engine.get("compilation_cache_dir", None)
-    if not directory and not jax_cc:
+    # the tuning store rides the same respawn plumbing: children
+    # resolve the SAME winners, so a respawn recompiles nothing new
+    # (literal env name — importing veles_tpu.autotune here would cycle)
+    tune_dir = root.common.get("autotune", {}).get("dir", None)
+    if not directory and not jax_cc and not tune_dir:
         return env
     env = dict(os.environ if env is None else env)
     if directory:
@@ -218,6 +222,9 @@ def inject_env(env=None):
         # the child — the one-knob satellite rides along
         env.setdefault("JAX_COMPILATION_CACHE_DIR",
                        os.path.abspath(str(jax_cc)))
+    if tune_dir:
+        env.setdefault("VELES_AUTOTUNE_DIR",
+                       os.path.abspath(str(tune_dir)))
     return env
 
 

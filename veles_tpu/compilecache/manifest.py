@@ -25,7 +25,7 @@ class WarmupManifest:
     def __init__(self, path):
         self.path = os.path.abspath(path)
         self._lock = threading.Lock()
-        self._models = self._load()
+        self._models, self._configs = self._load()
 
     def _load(self):
         try:
@@ -34,24 +34,31 @@ class WarmupManifest:
             models = data.get("models", {})
             if not isinstance(models, dict):
                 raise ValueError("manifest 'models' is not a dict")
-            return {str(name): list(entries)
-                    for name, entries in models.items()}
+            configs = data.get("configs", {})
+            if not isinstance(configs, dict):
+                raise ValueError("manifest 'configs' is not a dict")
+            return ({str(name): list(entries)
+                     for name, entries in models.items()},
+                    {str(name): dict(sites)
+                     for name, sites in configs.items()})
         except FileNotFoundError:
-            return {}
+            return {}, {}
         except (OSError, ValueError) as exc:
             # a mangled manifest only loses warmup ORDER, never
             # correctness — start empty and say so once
             log.warning("warmup manifest %s unreadable (%s); starting "
                         "empty", self.path, exc)
-            return {}
+            return {}, {}
 
     def _save_locked(self):
         tmp = self.path + ".tmp.%d" % os.getpid()
         try:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            doc = {"models": self._models}
+            if self._configs:       # old readers only look at "models"
+                doc["configs"] = self._configs
             with open(tmp, "w") as f:
-                json.dump({"models": self._models}, f, indent=1,
-                          sort_keys=True)
+                json.dump(doc, f, indent=1, sort_keys=True)
                 f.flush()
                 os.fsync(f.fileno())
             os.rename(tmp, self.path)
@@ -79,6 +86,21 @@ class WarmupManifest:
             self._save_locked()
         return True
 
+    def record_config(self, model, site, config):
+        """Note the tuned config ``model`` resolved for autotune
+        ``site`` (e.g. ``serving.bucket_ladder``) — advisory, like
+        buckets: a warm restart reads the same geometry back before
+        compiling, so tuned winners never cost a fresh compile.
+        Returns True when the stored value changed."""
+        config = dict(config)
+        with self._lock:
+            sites = self._configs.setdefault(str(model), {})
+            if sites.get(str(site)) == config:
+                return False
+            sites[str(site)] = config
+            self._save_locked()
+        return True
+
     # -- reading -------------------------------------------------------------
     def buckets(self, model):
         """Recorded bucket sizes for ``model``, smallest first."""
@@ -87,14 +109,23 @@ class WarmupManifest:
                           for e in self._models.get(str(model), ())
                           if "bucket" in e)
 
+    def configs(self, model):
+        """Recorded tuned configs for ``model``: {site: config}."""
+        with self._lock:
+            return {site: dict(cfg) for site, cfg
+                    in self._configs.get(str(model), {}).items()}
+
     def models(self):
         with self._lock:
-            return sorted(self._models)
+            return sorted(set(self._models) | set(self._configs))
 
     def forget(self, model):
         """Drop one model's history (hot-unload / tests)."""
         with self._lock:
-            if self._models.pop(str(model), None) is None:
+            had = self._models.pop(str(model), None) is not None
+            had = (self._configs.pop(str(model), None)
+                   is not None) or had
+            if not had:
                 return False
             self._save_locked()
         return True

@@ -285,6 +285,17 @@ root.update({
             # thread (the server answers before the tail finishes)
             "background_warmup": False,
         },
+        "autotune": {
+            # persistent kernel/serving config tuning (veles_tpu/
+            # autotune/): measured winners keyed by (site, shape class,
+            # device kind, jax/jaxlib versions) live under ``dir`` and
+            # kernel call sites resolve through them.  None = no store
+            # configured — every site uses its hand-picked default,
+            # byte-for-byte the pre-autotune behavior;
+            # $VELES_AUTOTUNE_DIR overrides for child processes.
+            "dir": None,
+            "enabled": True,
+        },
         "loader": {
             # background minibatch prefetch lookahead on the per-step
             # training path (loader/prefetch.py): how many minibatches a

@@ -122,18 +122,38 @@ class DecodeScheduler:
     admission also waits for free blocks.
     """
 
-    def __init__(self, model, *, max_batch=8, block_size=8,
+    def __init__(self, model, *, max_batch=None, block_size=None,
                  max_prompt_len=32, max_new_tokens=32, num_blocks=None,
                  queue_limit=64, name="decode", metrics=None,
                  cache=None, manifest=None, warmup=True):
         self.name = name
         self.model = model
-        self.max_batch = int(max_batch)
-        self.block_size = int(block_size)
         self.max_prompt_len = int(max_prompt_len)
         self.max_new_tokens = int(max_new_tokens)
         self.queue_limit = int(queue_limit)
         self.max_context = self.max_prompt_len + self.max_new_tokens
+        # the decode geometry is a TUNABLE SITE (serving.decode):
+        # explicit kwargs pin it; otherwise a tuning record for this
+        # context-length class picks the measured (max_batch,
+        # block_size), and tuner off = the historical (8, 8) defaults
+        # exactly
+        if max_batch is not None and block_size is not None:
+            self.config_source = "explicit"
+            cfg = {"max_batch": int(max_batch),
+                   "block_size": int(block_size)}
+        else:
+            from ..autotune import dispatch as _autotune
+            from ..znicz.paged_attention import DEFAULT_BLOCK_SIZE
+            cfg, self.config_source = _autotune.resolve(
+                "serving.decode", "ctx%d" % self.max_context,
+                default={"max_batch": 8,
+                         "block_size": DEFAULT_BLOCK_SIZE})
+            if max_batch is not None:
+                cfg["max_batch"] = int(max_batch)
+            if block_size is not None:
+                cfg["block_size"] = int(block_size)
+        self.max_batch = int(cfg["max_batch"])
+        self.block_size = int(cfg["block_size"])
         self.max_blocks = required_blocks(self.max_context,
                                           self.block_size)
         if num_blocks is None:
@@ -190,6 +210,14 @@ class DecodeScheduler:
             self._manifest = WarmupManifest(manifest)
         else:
             self._manifest = manifest or None
+        if self._manifest is not None and self.config_source == "tuned":
+            # winners ride the warmup manifest: a warm restart decodes
+            # with the SAME tuned geometry, so the cached executable
+            # matches and nothing recompiles
+            self._manifest.record_config(
+                self.name, "serving.decode",
+                {"max_batch": self.max_batch,
+                 "block_size": self.block_size})
         self._warmed = False
         if warmup:
             self.warmup()
@@ -976,6 +1004,7 @@ class DecodeScheduler:
             "queue_depth": self._depth,
             "queue_limit": self.queue_limit,
             "max_batch": self.max_batch,
+            "config_source": self.config_source,
             "active_sequences": len(self._sessions),
             "migrating_sessions": len(self._migrating),
             "block_size": self.block_size,

@@ -195,7 +195,7 @@ class BucketScheduler:
     def __init__(self, model, max_batch=64, queue_limit=256, workers=1,
                  max_wait=0.0, warmup=True, name="default",
                  metrics=None, sample_shape=None, cache=None,
-                 manifest=None, background_warmup=None):
+                 manifest=None, background_warmup=None, buckets=None):
         from ..config import root
         self.name = name
         self.max_batch = int(max_batch)
@@ -204,7 +204,28 @@ class BucketScheduler:
         self.metrics = metrics or ServingMetrics(name)
         self._adapter = adapt_model(model, sample_shape)
         self.sample_shape = self._adapter.sample_shape
-        self.buckets = bucket_sizes(self.max_batch)
+        # the bucket ladder is a TUNABLE SITE (serving.bucket_ladder):
+        # an explicit ``buckets`` list pins it; otherwise a tuning
+        # record for this max_batch picks the measured shape, and the
+        # tuner-off fallback ("pow2") is byte-identical to the old
+        # hard-wired bucket_sizes() ladder
+        if buckets is not None:
+            self.buckets = sorted({int(b) for b in buckets})
+            if self.buckets[-1] != self.max_batch or self.buckets[0] < 1:
+                raise ValueError(
+                    "buckets %r must be >= 1 and end at max_batch %d"
+                    % (buckets, self.max_batch))
+            self.bucket_config = {"shape": "explicit"}
+            self.config_source = "explicit"
+        else:
+            from ..autotune import dispatch as _autotune
+            from ..autotune import space as _space
+            cfg, src = _autotune.resolve(
+                "serving.bucket_ladder", "mb%d" % self.max_batch,
+                default={"shape": "pow2"})
+            self.buckets = _space.ladder(cfg["shape"], self.max_batch)
+            self.bucket_config = dict(cfg)
+            self.config_source = src
         self._executables = {}
         self._compiles = 0              # fresh XLA compiles only
         self._cache_hits = 0            # executables loaded off disk
@@ -224,6 +245,13 @@ class BucketScheduler:
             self._manifest = WarmupManifest(manifest)
         else:
             self._manifest = manifest or None
+        if self._manifest is not None and self.config_source == "tuned":
+            # ship the winner inside the warmup manifest: a warm
+            # restart reads the SAME ladder before compiling anything,
+            # so tuned geometry never causes a fresh compile
+            self._manifest.record_config(
+                self.name, "serving.bucket_ladder",
+                dict(self.bucket_config, buckets=list(self.buckets)))
         if background_warmup is None:
             background_warmup = bool(root.common.compile_cache.get(
                 "background_warmup", False))
@@ -596,6 +624,8 @@ class BucketScheduler:
         """
         return {
             "buckets": list(self.buckets),
+            "bucket_config": dict(self.bucket_config,
+                                  config_source=self.config_source),
             "executables": len(self._executables),
             "compiles": self._compiles,
             "cache_hits": self._cache_hits,

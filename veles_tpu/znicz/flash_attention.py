@@ -456,17 +456,43 @@ def _warn_fallback(t):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    window=None):
+def _flash_attention(q, k, v, causal, scale, block_q, block_k,
+                     window):
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                        window)
+    return out
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, window=None):
     """Flash attention, [B, T, H, D] — drop-in for
     ``attention_reference`` (falls back to it, with a logged warning,
     when T can't be tiled).  ``window`` (requires ``causal``):
     sliding-window attention — position i sees keys in
-    (i - window, i]; off-band blocks skip their MXU work entirely."""
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                        window)
-    return out
+    (i - window, i]; off-band blocks skip their MXU work entirely.
+
+    ``block_q``/``block_k`` default to the measured winner for this
+    (T, D, device, versions) when a tuning record exists (autotune
+    sites ``flash_attention`` / ``window_attention``), else the
+    hand-picked :data:`DEFAULT_BLOCK_Q`/:data:`DEFAULT_BLOCK_K`;
+    explicit values always win.  Resolution happens at trace time
+    (shapes are static), outside the custom-vjp boundary."""
+    if block_q is None or block_k is None:
+        from ..autotune import dispatch as _autotune
+        site = "window_attention" if window is not None \
+            else "flash_attention"
+        ctx = {"t": q.shape[1], "d": q.shape[3], "causal": causal}
+        if window is not None:
+            ctx["window"] = window
+        from ..autotune.space import site as _site
+        cfg, _ = _autotune.resolve(
+            site, _site(site).shape_class(ctx),
+            default={"block_q": DEFAULT_BLOCK_Q,
+                     "block_k": DEFAULT_BLOCK_K})
+        block_q = block_q if block_q is not None else int(cfg["block_q"])
+        block_k = block_k if block_k is not None else int(cfg["block_k"])
+    return _flash_attention(q, k, v, causal, scale, block_q, block_k,
+                            window)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None):
@@ -508,4 +534,4 @@ def _flash_bwd(causal, scale, block_q, block_k, window, res, g):
     return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))
 
 
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -79,8 +79,14 @@ _ACCUMULATORS = {0: _accumulate_plain, 1: _accumulate_kahan,
                  2: _accumulate_klein}
 
 
-def _matmul_impl(a, b, level, interpret, block_m=128, block_n=128,
-                 block_k=256):
+#: hand-picked tile sizes — the `precise_gemm` autotune site's default
+DEFAULT_BLOCK_M = 128
+DEFAULT_BLOCK_N = 128
+DEFAULT_BLOCK_K = 256
+
+
+def _matmul_impl(a, b, level, interpret, block_m=None, block_n=None,
+                 block_k=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -92,6 +98,21 @@ def _matmul_impl(a, b, level, interpret, block_m=128, block_n=128,
     k2, n = b.shape
     if k != k2:
         raise ValueError("shape mismatch %s @ %s" % (a.shape, b.shape))
+    if block_m is None or block_n is None or block_k is None:
+        # unpinned tiles resolve through the tuning store (clean miss /
+        # tuner off = the hand-picked defaults, exactly) — forward and
+        # backward matmuls each resolve for their OWN (m, k, n) class
+        from ..autotune import dispatch as _autotune
+        from ..autotune.space import site as _site
+        ctx = {"m": m, "k": k, "n": n, "level": int(level)}
+        cfg, _ = _autotune.resolve(
+            "precise_gemm", _site("precise_gemm").shape_class(ctx),
+            default={"block_m": DEFAULT_BLOCK_M,
+                     "block_n": DEFAULT_BLOCK_N,
+                     "block_k": DEFAULT_BLOCK_K})
+        block_m = block_m if block_m is not None else int(cfg["block_m"])
+        block_n = block_n if block_n is not None else int(cfg["block_n"])
+        block_k = block_k if block_k is not None else int(cfg["block_k"])
     bm, bn, bk = min(block_m, m), min(block_n, n), min(block_k, k)
     pad_m, pad_n, pad_k = (-m) % bm, (-n) % bn, (-k) % bk
     if pad_m or pad_k:
@@ -136,7 +157,10 @@ def _matmul_impl(a, b, level, interpret, block_m=128, block_n=128,
         out_shape=jax.ShapeDtypeStruct(
             (a.shape[0], b.shape[1]), jnp.float32),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)] * 3,
-        compiler_params=pltpu.CompilerParams(
+        # CompilerParams was TPUCompilerParams before jax 0.5
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams",
+                                        None))(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(a, b)

@@ -94,7 +94,25 @@ def _pallas_interpret():
 _LRN_BLOCK_ROWS = 1024
 
 
-def _lrn_grid(x):
+def lrn_mxu(x, n, alpha, beta, k):
+    """The MXU-band LRN forward as a free function (the math of the
+    default ``apply`` path) — the ``impl: "mxu"`` layout candidate of
+    the ``lrn`` autotune site, and what a tuned record dispatches to
+    when the band measured faster than the Pallas pair."""
+    import jax.numpy as jnp
+    from jax import lax
+    acc = _window_sum_mxu(x * x, n)
+    den = k + (alpha / n) * acc
+    if beta == 0.75:
+        # den^-3/4 = rsqrt(den) * sqrt(rsqrt(den)) — two cheap HW
+        # ops instead of the exp/log pair a general pow lowers to
+        # (AlexNet's default beta; the generic path stays below)
+        r = lax.rsqrt(den)
+        return x * (r * jnp.sqrt(r))
+    return x / den ** beta
+
+
+def _lrn_grid(x, block_rows=None):
     """Flatten [..., C] to [N, C] and tile N into VMEM-sized row blocks.
 
     The round-3 kernel mapped the WHOLE array into one kernel invocation
@@ -102,20 +120,22 @@ def _lrn_grid(x):
     >20 min on the oversized block and the bench recorded a timeout
     every round.  A trivial gridded kernel compiles on the same tunneled
     chip in <1 s (round-4 probe), so the fix is simply a real grid:
-    1024xC row tiles (~0.4-1 MB VMEM each), rows independent because the
+    row tiles of ``block_rows`` (default 1024, ~0.4-1 MB VMEM; tunable
+    via the ``lrn`` autotune site), rows independent because the
     LRN window runs along C only.  Block-padding rows beyond N is safe —
     padded rows produce garbage that is never written back."""
     import jax.numpy as jnp
     c = x.shape[-1]
     flat = x.reshape(-1, c)
     from jax.experimental import pallas as pl
-    grid = (pl.cdiv(flat.shape[0], _LRN_BLOCK_ROWS),)
-    spec = pl.BlockSpec((_LRN_BLOCK_ROWS, c), lambda i: (i, 0))
+    rows = int(block_rows or _LRN_BLOCK_ROWS)
+    grid = (pl.cdiv(flat.shape[0], rows),)
+    spec = pl.BlockSpec((rows, c), lambda i: (i, 0))
     return flat, grid, spec
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
-def pallas_lrn(x, n, alpha, beta, k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def pallas_lrn(x, n, alpha, beta, k, block_rows=None):
     """Fused cross-channel LRN forward (Pallas, gridded row tiles)."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -125,7 +145,7 @@ def pallas_lrn(x, n, alpha, beta, k):
         acc = _window_sum(xv * xv, n, jnp)
         o_ref[...] = xv / (k + (alpha / n) * acc) ** beta
 
-    flat, grid, spec = _lrn_grid(x)
+    flat, grid, spec = _lrn_grid(x, block_rows)
     out = pl.pallas_call(
         kernel, grid=grid, in_specs=[spec], out_specs=spec,
         out_shape=jax.ShapeDtypeStruct(flat.shape, flat.dtype),
@@ -133,11 +153,11 @@ def pallas_lrn(x, n, alpha, beta, k):
     return out.reshape(x.shape)
 
 
-def _pallas_lrn_fwd(x, n, alpha, beta, k):
-    return pallas_lrn(x, n, alpha, beta, k), x
+def _pallas_lrn_fwd(x, n, alpha, beta, k, block_rows=None):
+    return pallas_lrn(x, n, alpha, beta, k, block_rows), x
 
 
-def _pallas_lrn_bwd(n, alpha, beta, k, x, g):
+def _pallas_lrn_bwd(n, alpha, beta, k, block_rows, x, g):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
@@ -151,7 +171,7 @@ def _pallas_lrn_bwd(n, alpha, beta, k, x, g):
                       2.0 * beta * c * xv *
                       _window_sum(inner, n, jnp, transpose=True))
 
-    flat, grid, spec = _lrn_grid(x)
+    flat, grid, spec = _lrn_grid(x, block_rows)
     gflat = g.reshape(flat.shape)
     dx = pl.pallas_call(
         kernel, grid=grid, in_specs=[spec, spec], out_specs=spec,
@@ -186,23 +206,28 @@ class LRNormalizerForward(ParamlessForward):
         return (self.k + (self.alpha / self.n) * acc) ** self.beta
 
     def apply(self, params, x):
-        import jax.numpy as jnp
-        from jax import lax
         from .nn_units import resolve_use_pallas
         if resolve_use_pallas(self.use_pallas, self.device,
                               tpu_auto=False):
-            return pallas_lrn(x, self.n, self.alpha, self.beta, self.k)
+            # the pallas path is a TUNABLE SITE: with a tuning record
+            # for this (C, n, device, versions) the measured winner
+            # decides the row-tile size — or the mxu band LAYOUT, the
+            # answer when the pallas_call fusion boundary loses on this
+            # device class.  Tuner off = the exact hand-picked kernel.
+            from ..autotune import dispatch as _autotune
+            cfg, src = _autotune.resolve(
+                "lrn", "c%d_n%d" % (x.shape[-1], self.n),
+                default={"impl": "pallas",
+                         "block_rows": _LRN_BLOCK_ROWS})
+            self.config_source = src
+            if cfg.get("impl") != "mxu":
+                return pallas_lrn(x, self.n, self.alpha, self.beta,
+                                  self.k, int(cfg["block_rows"]))
+        else:
+            self.config_source = "default"
         # MXU path: one banded matmul instead of n shifted HBM passes
         # (autodiff gives the transposed band for the backward)
-        acc = _window_sum_mxu(x * x, self.n)
-        den = self.k + (self.alpha / self.n) * acc
-        if self.beta == 0.75:
-            # den^-3/4 = rsqrt(den) * sqrt(rsqrt(den)) — two cheap HW
-            # ops instead of the exp/log pair a general pow lowers to
-            # (AlexNet's default beta; the generic path stays below)
-            r = lax.rsqrt(den)
-            return x * (r * jnp.sqrt(r))
-        return x / den ** self.beta
+        return lrn_mxu(x, self.n, self.alpha, self.beta, self.k)
 
     def apply_numpy(self, params, x):
         return x / self._den(x * x, numpy)

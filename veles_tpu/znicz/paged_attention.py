@@ -44,10 +44,16 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["paged_attention", "paged_attention_reference",
-           "required_blocks"]
+__all__ = ["DEFAULT_BLOCK_SIZE", "paged_attention",
+           "paged_attention_reference", "required_blocks"]
 
 _NEG_INF = float("-inf")
+
+#: hand-picked KV page size (tokens per pool block).  The kernel reads
+#: the actual size off the pool shape — this is the default the decode
+#: scheduler builds pools with when nothing is pinned, and the
+#: ``paged_attention`` autotune site's baseline candidate.
+DEFAULT_BLOCK_SIZE = 8
 
 
 def _interpret():
