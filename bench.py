@@ -800,6 +800,60 @@ def bench_decode(probe_timeout=240):
     return out
 
 
+def bench_prefix_reuse(probe_timeout=300):
+    """Chunked prefill + prefix-aware KV reuse (ISSUE 14 acceptance:
+    short-request TTFT p99 >= 3x better when long prefills are chunked
+    and interleaved with decode, > 50% of blocks reused across
+    sequences sharing a system prompt with bitwise-oracle tokens, and
+    zero steady-state recompiles across a warm restart — the chunk
+    executable rides the same manifest as the decode step).  Cold/warm
+    probe pair like the decode stage: two fresh subprocesses sharing
+    one cache dir, the second IS the restart."""
+    import subprocess
+    import tempfile
+    _stamp("prefix-reuse stage")
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "serve_bench.py")
+    cache_dir = os.path.join(
+        tempfile.mkdtemp(prefix="veles-prefix-bench-"), "compile_cache")
+
+    def probe(tag):
+        argv = [sys.executable, tool, "--shared-prefix", "16",
+                "--prefix-waves", "8", "--json",
+                "--cache-dir", cache_dir]
+        proc = subprocess.run(argv, capture_output=True,
+                              timeout=probe_timeout)
+        line = _last_json_line(proc.stdout.decode())
+        if line is None:
+            raise RuntimeError("prefix probe (%s) failed: %s"
+                               % (tag, proc.stderr.decode()[-400:]))
+        _stamp("prefix %s: ttft p99 %s ms mono vs %s ms chunked (%sx), "
+               "reuse %s, %s post-warmup compiles"
+               % (tag, line.get("prefix_ttft_p99_monolithic_ms"),
+                  line.get("prefix_ttft_p99_chunked_ms"),
+                  line.get("prefix_ttft_p99_speedup"),
+                  line.get("prefix_reused_fraction"),
+                  line.get("prefix_post_warmup_compiles")))
+        return line
+
+    cold = probe("cold")
+    warm = probe("warm")        # the restart: manifest + cache replay
+    keys = ("prefix_ttft_p50_monolithic_ms",
+            "prefix_ttft_p99_monolithic_ms",
+            "prefix_ttft_p50_chunked_ms", "prefix_ttft_p99_chunked_ms",
+            "prefix_ttft_p99_speedup", "prefix_reused_fraction",
+            "prefix_hits", "prefix_dedup_blocks",
+            "prefix_published_blocks", "prefix_tokens_match",
+            "prefix_post_warmup_compiles",
+            "prefix_chunked_post_warmup_compiles")
+    out = {k: warm.get(k) for k in keys}
+    out["prefix_cold_compiles"] = cold.get("prefix_compiles")
+    out["prefix_warm_compiles"] = warm.get("prefix_compiles")
+    out["prefix_config"] = _autotune_provenance(
+        "serving.prefill_chunk", {"max_prompt_len": 64})
+    return out
+
+
 def bench_fleet(replicas=3, probe_timeout=360):
     """Multi-replica serving fleet (ISSUE 7 acceptance: >= 0.8
     replica-scaling efficiency on the open-loop serve_bench load, a
@@ -1408,6 +1462,8 @@ def _stage_main(stage):
         out = bench_cold_start()
     elif stage == "decode":
         out = bench_decode()
+    elif stage == "prefix_reuse":
+        out = bench_prefix_reuse()
     elif stage == "fleet":
         out = bench_fleet()
     elif stage == "chaos":
@@ -1476,6 +1532,11 @@ STAGE_PLAN = [
     # steady-state recompiles across a warm restart) — two fresh
     # subprocesses (cold populates the cache, warm IS the restart)
     ("decode", 420),
+    # chunked prefill + prefix-aware KV reuse (ISSUE 14): short-request
+    # TTFT p99 >= 3x under head-of-line long prefills, > 50% block
+    # dedupe across shared-system-prompt sequences with oracle-bitwise
+    # tokens, warm restart compiles == 0 including the chunk executable
+    ("prefix_reuse", 300),
     # multi-replica serving fleet: scaling efficiency, SIGKILL
     # kill-recovery (zero non-429 failures, warm compiles==0 respawn)
     # and rolling-update error rate (ISSUE 7) — one fresh subprocess

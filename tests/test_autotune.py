@@ -68,7 +68,8 @@ def test_every_site_default_is_its_own_first_candidate():
             "paged_attention": {"batch": 2, "heads": 2, "d": 16,
                                 "length": 48},
             "serving.bucket_ladder": {"max_batch": 16},
-            "serving.decode": {"max_context": 64}}
+            "serving.decode": {"max_context": 64},
+            "serving.prefill_chunk": {"max_prompt_len": 64}}
     assert set(ctxs) == set(space.SITES)
     for name, ctx in ctxs.items():
         sp = space.site(name)
@@ -98,6 +99,9 @@ def test_space_defaults_match_kernel_constants():
         "block_size": pa.DEFAULT_BLOCK_SIZE}
     assert space.site("serving.decode").default == {
         "max_batch": 8, "block_size": pa.DEFAULT_BLOCK_SIZE}
+    from veles_tpu.serving import decode
+    assert space.site("serving.prefill_chunk").default == {
+        "chunk_tokens": decode.DEFAULT_PREFILL_CHUNK}
 
 
 def test_ladder_pow2_is_byte_identical_to_bucket_sizes():
@@ -480,6 +484,39 @@ def test_decode_scheduler_tuned_explicit_and_off_geometry(tune_dir):
     finally:
         root.common.autotune.dir = prior
         dispatch.reset_default_stores()
+
+
+def test_prefill_chunk_tuned_auto_and_explicit(tune_dir):
+    """``prefill_chunk_tokens="auto"`` consults the store under the
+    mp<bucket> shape class; an int pins the chunk regardless; the
+    default (None) keeps the monolithic ladder and resolves nothing."""
+    from veles_tpu.serving.decode import DecodeScheduler
+    from veles_tpu.serving.toydecode import ToyDecodeModel
+    model = ToyDecodeModel(vocab=31)
+    st = store.TuningStore(tune_dir)
+    st.put("serving.prefill_chunk", "mp8", {"chunk_tokens": 8},
+           default={"chunk_tokens": 32}, speedup=1.5)
+    dispatch.reset_default_stores()
+    s = DecodeScheduler(model, max_batch=2, block_size=4,
+                        max_prompt_len=8, max_new_tokens=8,
+                        cache=False, warmup=False,
+                        prefill_chunk_tokens="auto")
+    assert s.chunk_tokens == 8
+    assert s.stats()["chunk_source"] == "tuned"
+    s.close()
+    s2 = DecodeScheduler(model, max_batch=2, block_size=4,
+                         max_prompt_len=8, max_new_tokens=8,
+                         cache=False, warmup=False,
+                         prefill_chunk_tokens=4)
+    assert s2.chunk_tokens == 4
+    assert s2.stats()["chunk_source"] == "explicit"
+    s2.close()
+    s3 = DecodeScheduler(model, max_batch=2, block_size=4,
+                         max_prompt_len=8, max_new_tokens=8,
+                         cache=False, warmup=False)
+    assert s3.chunk_tokens is None
+    assert "chunk_source" not in s3.stats()
+    s3.close()
 
 
 def test_manifest_configs_roundtrip_and_backward_compat(tmp_path):

@@ -1,0 +1,522 @@
+"""Prefix-aware KV block reuse + chunked prefill (ISSUE 14).
+
+The contract under test (acceptance):
+- with both knobs OFF (the default) behavior is bit-for-bit the prior
+  scheduler: monolithic prefill ladder, plain free-list pool, no prefix
+  keys in stats — MIGRATION.md's "default-off" note is test-enforced
+  here;
+- chunked prefill emits EXACTLY the cache-free oracle's tokens and
+  interleaves with decode: a short request submitted behind a long
+  prefill gets its first token without waiting for the whole prompt;
+- sequences sharing a token prefix attach to already-resident blocks
+  (refcounted); divergence never mutates a shared block — every
+  follower's tokens stay bitwise equal to its solo run even while the
+  seed's blocks are being re-read (the toydecode fingerprint);
+- the pool never frees a referenced block, never leaks after drain, and
+  keeps free+private+shared+cached an exact partition of capacity under
+  random admit/publish/release churn;
+- deduped sessions migrate over the wire encoding and checkpoint /
+  restore with their pool accounting intact, same tokens;
+- a warm restart through the compile cache + manifest compiles NOTHING
+  — the chunk executable is one more manifest entry, not a recompile;
+- ``GET /api/<model>/kv`` serves the pool dump tools/kv_inspect.py
+  verifies.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.serving import (DecodeScheduler, InferenceServer,
+                               KVBlockPool, ToyDecodeModel)
+from veles_tpu.serving.kvcache import key_chain, required_blocks
+from veles_tpu.serving.sessions import pack_states, unpack_states
+from veles_tpu.znicz.samples.flagship import (FlagshipDecodeModel,
+                                              generate_reference)
+
+GEOM = dict(max_batch=3, block_size=4, max_prompt_len=16,
+            max_new_tokens=8)
+PREFIX_GEOM = dict(GEOM, prefix_caching=True, prefill_chunk_tokens=4)
+
+
+@pytest.fixture(scope="module")
+def toy():
+    return ToyDecodeModel(vocab=31)
+
+
+@pytest.fixture(scope="module")
+def toy_oracle(toy):
+    memo = {}
+
+    def run(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in memo:
+            memo[key] = toy.generate_reference(prompt, n)
+        return memo[key]
+    return run
+
+
+# -- key chain ----------------------------------------------------------------
+
+def test_key_chain_commits_to_whole_prefix():
+    ks = key_chain([1, 2, 3, 4, 5, 6, 7, 8, 9], 4)
+    assert len(ks) == 2                       # trailing partial unkeyed
+    same = key_chain([1, 2, 3, 4, 5, 6, 7, 8], 4)
+    assert ks == same
+    # equal second block, different first -> BOTH keys differ (rolling)
+    other = key_chain([9, 2, 3, 4, 5, 6, 7, 8], 4)
+    assert other[0] != ks[0] and other[1] != ks[1]
+    assert key_chain([1, 2, 3], 4) == []
+
+
+# -- pool property churn ------------------------------------------------------
+
+def test_pool_prefix_churn_invariants():
+    """Random admit/publish/release/retire churn over a tight pool:
+    the four domains stay an exact partition, referenced blocks cannot
+    be freed, and a full drain leaves zero live blocks (cached prefix
+    content may stay resident — that is the feature)."""
+    rng = numpy.random.RandomState(11)
+    bs = 4
+    pool = KVBlockPool(num_blocks=17, block_size=bs,
+                       prefix_caching=True)
+    # a small prompt universe so chains really collide
+    universe = [rng.randint(0, 50, rng.randint(4, 15)).tolist()
+                for _ in range(5)]
+    live = []                                 # (prompt, shared, private)
+    for step in range(400):
+        if live and rng.rand() < 0.45:        # retire one session
+            prompt, shared, private = live.pop(rng.randint(len(live)))
+            keys = key_chain(prompt, bs)
+            blocks = shared + private
+            for i, key in enumerate(keys):    # publish full blocks
+                if i < len(blocks) and not pool.is_shared(blocks[i]):
+                    pool.publish(blocks[i], key)
+            owned = [b for b in blocks if pool.is_shared(b)]
+            pool.release(owned)
+            pool.free([b for b in blocks if b not in owned])
+        else:                                 # admit a session
+            prompt = universe[rng.randint(len(universe))]
+            keys = key_chain(prompt, bs)[:(len(prompt) - 1) // bs]
+            shared = pool.acquire_prefix(keys)
+            need = required_blocks(len(prompt), bs) - len(shared)
+            private = pool.alloc(need) if need else []
+            if need and private is None:
+                if shared:
+                    pool.release(shared)
+                continue
+            live.append((prompt, shared, private))
+            if shared:                        # referenced -> unfreeable
+                with pytest.raises(ValueError, match="referenced"):
+                    pool.free([shared[0]])
+        assert pool.check_integrity() == [], step
+    for prompt, shared, private in live:      # drain everything
+        pool.release(shared)
+        pool.free(private)
+    assert pool.live_blocks == 0
+    assert pool.free_blocks + pool.cached_blocks == pool.capacity
+    assert pool.check_integrity() == []
+    stats = pool.stats()
+    assert stats["prefix_hits"] > 0 and stats["dedup_blocks"] > 0
+
+
+def test_pool_misuse_raises():
+    pool = KVBlockPool(num_blocks=6, block_size=4, prefix_caching=True)
+    off = KVBlockPool(num_blocks=6, block_size=4)
+    with pytest.raises(RuntimeError):
+        off.acquire_prefix([b"x"])            # machinery gated off
+    (b,) = pool.alloc(1)
+    assert pool.publish(b, b"k1")
+    (b2,) = pool.alloc(1)
+    assert not pool.publish(b2, b"k1")        # first writer wins
+    assert not pool.is_shared(b2)             # stays a private copy
+    with pytest.raises(ValueError):
+        pool.publish(b, b"k2")                # already shared
+    with pytest.raises(ValueError):
+        pool.free([b])                        # referenced
+    pool.release([b])
+    with pytest.raises(ValueError):
+        pool.free([b])                        # cached: only eviction
+    with pytest.raises(ValueError):
+        pool.release([b2])                    # never shared
+    assert pool.check_integrity() == []
+
+
+def test_pool_cached_blocks_evict_lru_under_pressure():
+    pool = KVBlockPool(num_blocks=5, block_size=4, prefix_caching=True)
+    blocks = pool.alloc(4)
+    for i, b in enumerate(blocks):
+        pool.publish(b, b"key%d" % i)
+    pool.release(blocks)                      # all 4 parked in LRU
+    assert pool.cached_blocks == 4 and pool.free_blocks == 0
+    assert pool.alloc(2) is not None          # evicts the 2 oldest
+    assert pool.evicted_blocks == 2
+    assert pool.acquire_prefix([b"key0"]) == []     # oldest gone
+    assert len(pool.acquire_prefix([b"key3"])) == 1  # newest survives
+    assert pool.check_integrity() == []
+
+
+# -- chunked prefill ----------------------------------------------------------
+
+def test_chunked_prefill_matches_oracle_toy(toy, toy_oracle):
+    s = DecodeScheduler(toy, name="chunktoy", **GEOM,
+                        prefill_chunk_tokens=4)
+    try:
+        before = s.stats()
+        rng = numpy.random.RandomState(2)
+        requests = [(rng.randint(0, 31, rng.randint(1, 17)).tolist(),
+                     int(rng.randint(1, 9))) for _ in range(12)]
+        futures = [s.submit(p, n) for p, n in requests]
+        for (p, n), f in zip(requests, futures):
+            assert f.result(60)["tokens"] == toy_oracle(p, n)
+        after = s.stats()
+        # ONE chunk executable serves every prompt length: no ladder,
+        # no steady-state recompiles
+        assert after["executables"] == 2      # decode + chunk
+        assert after["compiles"] == before["compiles"]
+        assert after["post_warmup_compiles"] == 0
+        assert after["prefill_chunk_tokens"] == 4
+        stats = after
+        assert stats["free_blocks"] == stats["num_blocks"] - 1
+    finally:
+        s.close(drain=True)
+
+
+def test_chunked_prefill_matches_oracle_flagship():
+    model = FlagshipDecodeModel(stages=2, experts=2, d=16, heads=2,
+                                hidden=32, vocab=32, seed=0)
+    s = DecodeScheduler(model, name="chunkflag", max_batch=3,
+                        block_size=4, max_prompt_len=12,
+                        max_new_tokens=6, prefill_chunk_tokens=4)
+    try:
+        rng = numpy.random.RandomState(3)
+        requests = [(rng.randint(0, 32, rng.randint(1, 13)).tolist(), 6)
+                    for _ in range(6)]
+        futures = [s.submit(p, n) for p, n in requests]
+        for (p, n), f in zip(requests, futures):
+            assert f.result(120)["tokens"] == \
+                generate_reference(model.params, p, n)
+        assert s.stats()["post_warmup_compiles"] == 0
+    finally:
+        s.close(drain=True)
+
+
+def test_chunking_interleaves_short_request_ttft(toy_oracle):
+    """A short request submitted right after a long prompt gets its
+    first token WITHOUT waiting out the whole long prefill when
+    chunking is on (the per-prompt-token host-delay stand-in pins the
+    prefill cost, so the ordering is deterministic, not a race)."""
+    model = ToyDecodeModel(vocab=31, prefill_delay=0.004)
+    long_prompt = list(range(1, 31)) + [1, 2]         # 32 tokens
+    short_prompt = [3, 1, 4]
+
+    def ttft(chunk):
+        s = DecodeScheduler(model, name="hol%s" % (chunk or 0),
+                            max_batch=2, block_size=4,
+                            max_prompt_len=32, max_new_tokens=4,
+                            prefill_chunk_tokens=chunk)
+        try:
+            f_long = s.submit(long_prompt, 4)
+            f_short = s.submit(short_prompt, 4)
+            out = f_short.result(60)
+            assert out["tokens"] == toy_oracle(short_prompt, 4)
+            assert f_long.result(60)["tokens"] == \
+                toy_oracle(long_prompt, 4)
+            return out["ttft_s"]
+        finally:
+            s.close(drain=True)
+
+    mono, chunked = ttft(None), ttft(4)
+    # monolithic: the short TTFT contains the full 32-token prefill
+    # (>= 128 ms of pinned delay); chunked: only a few 4-token chunks
+    assert chunked < mono * 0.6, (mono, chunked)
+
+
+def test_knobs_default_off_is_prior_behavior(toy):
+    """MIGRATION.md note, enforced: a default-constructed scheduler has
+    neither knob on — monolithic ladder executables, no prefix keys in
+    stats, plain pool."""
+    s = DecodeScheduler(toy, name="defaults", **GEOM)
+    try:
+        stats = s.stats()
+        assert stats["prefix_caching"] is False
+        assert stats["prefill_chunk_tokens"] is None
+        assert stats["executables"] == 1 + len(stats["buckets"])
+        for key in ("prefix_hits", "dedup_blocks", "chunk_source"):
+            assert key not in stats
+    finally:
+        s.close(drain=True)
+    with pytest.raises(ValueError, match="prefix_caching"):
+        DecodeScheduler(toy, name="badknobs", **GEOM,
+                        prefix_caching=True, warmup=False)
+
+
+# -- prefix reuse + copy-on-write ---------------------------------------------
+
+def test_prefix_reuse_tokens_bitwise_toy(toy, toy_oracle):
+    """Followers sharing a system prompt attach to the seed's resident
+    blocks; every sequence still matches its solo run bitwise — the
+    toydecode recurrence READS the shared blocks through the page
+    table, so a single clobbered token would change the output."""
+    s = DecodeScheduler(toy, name="reusetoy", **PREFIX_GEOM)
+    try:
+        system = [7, 3, 7, 3, 5, 1, 5, 1]             # two full blocks
+        seed = system + [9]
+        assert s.generate(seed, 8, timeout=60)["tokens"] == \
+            toy_oracle(seed, 8)
+        followers = [system + [10 + i, 11 + i] for i in range(6)]
+        futures = [s.submit(p, 8) for p in followers]
+        for p, f in zip(followers, futures):
+            assert f.result(60)["tokens"] == toy_oracle(p, 8)
+        stats = s.stats()
+        assert stats["prefix_hits"] >= len(followers)
+        assert stats["dedup_blocks"] >= 2 * len(followers)
+        dump = s.kv_dump()
+        assert dump["integrity"] == []
+        # each follower also publishes its divergent tail, so the ratio
+        # sits below the bench's 80% — but reuse must still dominate
+        # the shared prefix: 2 of each follower's blocks came resident
+        assert dump["dedup_ratio"] >= 0.4
+    finally:
+        s.close(drain=True)
+
+
+def test_prefix_reuse_tokens_bitwise_flagship():
+    """Same contract on the real transformer: reused float KV blocks
+    produce the cache-free oracle's argmax tokens exactly."""
+    model = FlagshipDecodeModel(stages=2, experts=2, d=16, heads=2,
+                                hidden=32, vocab=32, seed=3)
+    s = DecodeScheduler(model, name="reuseflag", max_batch=3,
+                        block_size=4, max_prompt_len=12,
+                        max_new_tokens=4, prefix_caching=True,
+                        prefill_chunk_tokens=4)
+    try:
+        system = [5, 9, 2, 7, 1, 4, 6, 8]             # two full blocks
+        prompts = [system + [10 + i] for i in range(3)]
+        assert s.generate(prompts[0], 4, timeout=120)["tokens"] == \
+            generate_reference(model.params, prompts[0], 4)
+        futures = [s.submit(p, 4) for p in prompts[1:]]
+        for p, f in zip(prompts[1:], futures):
+            assert f.result(120)["tokens"] == \
+                generate_reference(model.params, p, 4)
+        stats = s.stats()
+        assert stats["prefix_hits"] >= 2
+        assert stats["dedup_blocks"] >= 4
+        assert stats["post_warmup_compiles"] == 0
+    finally:
+        s.close(drain=True)
+
+
+def test_multi_turn_resubmission_reuses_history(toy, toy_oracle):
+    """At retire the full history (prompt + generated) is published —
+    a follow-up turn that re-submits the conversation reuses it."""
+    s = DecodeScheduler(toy, name="multiturn", **PREFIX_GEOM)
+    try:
+        turn1 = [1, 2, 3, 4, 5]
+        out1 = s.generate(turn1, 7, timeout=60)
+        assert out1["tokens"] == toy_oracle(turn1, 7)
+        before = s.stats()["dedup_blocks"]
+        turn2 = turn1 + out1["tokens"] + [6]
+        out2 = s.generate(turn2, 3, timeout=60)
+        assert out2["tokens"] == toy_oracle(turn2, 3)
+        assert s.stats()["dedup_blocks"] > before
+    finally:
+        s.close(drain=True)
+
+
+def test_prefix_churn_never_corrupts_survivors(toy, toy_oracle):
+    """Property test: random shared-prefix traffic over a TIGHT pool
+    (constant eviction + revival + divergence) — every sequence still
+    equals its solo run, and the pool partition survives the churn."""
+    s = DecodeScheduler(toy, name="prefchurn", max_batch=3,
+                        block_size=4, max_prompt_len=12,
+                        max_new_tokens=8, num_blocks=14,
+                        prefix_caching=True, prefill_chunk_tokens=4)
+    try:
+        rng = numpy.random.RandomState(5)
+        systems = [[1, 2, 3, 4], [9, 8, 7, 6, 5, 4, 3, 2]]
+        requests = []
+        for _ in range(20):
+            base = systems[rng.randint(2)] if rng.rand() < 0.7 else []
+            tail = rng.randint(0, 31,
+                               rng.randint(1, 5)).tolist()
+            requests.append((base + tail, int(rng.randint(1, 9))))
+        futures = []
+        for i, (p, n) in enumerate(requests):
+            futures.append(s.submit(p, n))
+            if i % 4 == 0:
+                time.sleep(0.004)
+        for (p, n), f in zip(requests, futures):
+            assert f.result(60)["tokens"] == toy_oracle(p, n)
+        dump = s.kv_dump()
+        assert dump["integrity"] == []
+        stats = s.stats()
+        assert stats["active_sequences"] == 0
+        assert stats["prefix_hits"] > 0
+    finally:
+        s.close(drain=True)
+
+
+# -- migration / checkpoint of deduped sessions -------------------------------
+
+def test_deduped_sessions_migrate_bitwise(toy_oracle):
+    """Mid-generation sessions whose prompts share resident prefix
+    blocks export through the wire encoding and finish on the peer
+    with exactly the uninterrupted tokens; the source pool drains."""
+    model = ToyDecodeModel(vocab=31, step_delay=0.02)
+    a = DecodeScheduler(model, name="dedupa", **PREFIX_GEOM)
+    b = DecodeScheduler(model, name="dedupb", **PREFIX_GEOM)
+    try:
+        system = [2, 4, 6, 8, 1, 3, 5, 7]
+        seed = system + [9]
+        assert a.generate(seed, 8, timeout=60)["tokens"] == \
+            toy_oracle(seed, 8)
+        prompts = {"m%d" % i: system + [20 + i] for i in range(3)}
+        futures = {sid: a.submit(p, 8, session_id=sid)
+                   for sid, p in prompts.items()}
+        time.sleep(0.1)                        # a few steps into each
+        states = a.export_sessions()
+        assert states
+        exported = {st["session_id"] for st in states}
+        done, errors = b.import_sessions(
+            unpack_states(pack_states(states)))
+        assert errors == [] and set(done) == exported
+        a.release_migrated(done, target="peer:1")
+        for sid, p in prompts.items():
+            if sid in exported:
+                assert futures[sid].result(10)["migrated"]
+                kind, val = b.attach(sid)
+                result = val if kind == "finished" else val.result(60)
+            else:
+                result = futures[sid].result(60)
+            assert result["tokens"] == toy_oracle(p, 8), sid
+        for s in (a, b):
+            dump = s.kv_dump()
+            assert dump["integrity"] == [], s.name
+        stats = a.stats()
+        assert stats["active_sequences"] == 0
+        # the source drained: every block is free or cached, none live
+        assert stats["free_blocks"] + stats["cached_blocks"] == \
+            stats["num_blocks"] - 1
+    finally:
+        a.close(drain=True)
+        b.close(drain=True)
+
+
+def test_checkpoint_restore_with_shared_blocks(tmp_path, toy_oracle):
+    """checkpoint_kv captures the pool's shared/cached accounting;
+    restore_kv resumes deduped sequences bitwise in a fresh scheduler
+    (the rolling-update path for a prefix-caching fleet)."""
+    model = ToyDecodeModel(vocab=31, step_delay=0.02)
+    s1 = DecodeScheduler(model, name="ckpta", **PREFIX_GEOM)
+    s2 = None
+    try:
+        system = [3, 1, 4, 1, 5, 9, 2, 6]
+        seed = system + [8]
+        assert s1.generate(seed, 8, timeout=60)["tokens"] == \
+            toy_oracle(seed, 8)
+        prompts = [system + [11], system + [12], [7, 7]]
+        futures = [s1.submit(p, 8) for p in prompts]
+        time.sleep(0.1)
+        path = s1.checkpoint_kv(str(tmp_path))
+        # the source keeps running and still answers bitwise
+        for p, f in zip(prompts, futures):
+            assert f.result(60)["tokens"] == toy_oracle(p, 8)
+        s2 = DecodeScheduler(model, name="ckptb", **PREFIX_GEOM)
+        restored = s2.restore_kv(path)
+        assert restored
+        want = {tuple(toy_oracle(p, 8)) for p in prompts}
+        got = {tuple(f.result(60)["tokens"])
+               for f in restored.values()}
+        assert got <= want and len(got) == len(restored)
+        assert s2.kv_dump()["integrity"] == []
+    finally:
+        s1.close(drain=True)
+        if s2 is not None:
+            s2.close(drain=True)
+
+
+def test_restore_rejects_prefix_geometry_mismatch(tmp_path, toy):
+    s1 = DecodeScheduler(toy, name="geoa", **PREFIX_GEOM)
+    try:
+        path = s1.checkpoint_kv(str(tmp_path))
+    finally:
+        s1.close(drain=True)
+    s2 = DecodeScheduler(toy, name="geob", **GEOM)   # prefix OFF
+    try:
+        with pytest.raises(ValueError, match="geometry mismatch"):
+            s2.restore_kv(path)
+    finally:
+        s2.close(drain=True)
+
+
+# -- warm restart -------------------------------------------------------------
+
+def test_warm_restart_chunk_exe_compiles_nothing(tmp_path, toy,
+                                                 toy_oracle):
+    """The chunk executable rides the same persistent cache + manifest
+    as the decode step: a restart deserializes BOTH (compiles == 0) and
+    generates identical tokens — including re-deduped prefixes."""
+    from veles_tpu.compilecache import (default_cache,
+                                        reset_default_caches)
+    from veles_tpu.config import root
+    prior = root.common.compile_cache.get("dir", None)
+    root.common.compile_cache.dir = str(tmp_path / "cache")
+    reset_default_caches()
+    try:
+        prompt = [5, 4, 3, 2, 1, 6, 7, 8, 9]
+        s1 = DecodeScheduler(toy, name="prefres", **PREFIX_GEOM)
+        first = s1.stats()
+        r1 = s1.generate(prompt, 6, timeout=60)
+        s1.close(drain=True)
+        assert first["executables"] == 2      # decode + chunk, NO ladder
+        assert first["compiles"] == 2 and first["cache_hits"] == 0
+        s2 = DecodeScheduler(toy, name="prefres", **PREFIX_GEOM)
+        warm = s2.stats()
+        r2 = s2.generate(prompt, 6, timeout=60)
+        s2.close(drain=True)
+        assert warm["compiles"] == 0
+        assert warm["cache_hits"] == warm["executables"] == 2
+        assert r1["tokens"] == r2["tokens"] == toy_oracle(prompt, 6)
+        manifest = default_cache().manifest
+        assert manifest.buckets("prefres@decode") == [GEOM["max_batch"]]
+        assert manifest.buckets("prefres@chunk") == \
+            [PREFIX_GEOM["prefill_chunk_tokens"]]
+    finally:
+        root.common.compile_cache.dir = prior
+        reset_default_caches()
+
+
+# -- HTTP dump route + kv_inspect ---------------------------------------------
+
+def test_kv_dump_route_and_inspect(toy, toy_oracle):
+    from tools import kv_inspect
+    model = ToyDecodeModel(vocab=31, decode_defaults=PREFIX_GEOM)
+    srv = InferenceServer({"toy": model})
+    try:
+        base = "http://127.0.0.1:%d" % srv.port
+        prompt = [1, 2, 3, 4, 5, 6]
+        req = urllib.request.Request(
+            base + "/api/toy/generate",
+            json.dumps({"prompt": prompt, "max_new_tokens": 4}).encode(),
+            {"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert out["tokens"] == toy_oracle(prompt, 4)
+        assert kv_inspect.decode_models(base) == ["toy"]
+        dump = kv_inspect.fetch_dump(base, "toy")
+        assert kv_inspect.verify_dump(dump) == []
+        assert dump["model"] == "toy"
+        assert dump["prefix_caching"] is True
+        assert dump["prefill_chunk_tokens"] == 4
+        text = kv_inspect.describe(dump)
+        assert "integrity: ok" in text and "prefix caching on" in text
+        # unknown / non-decode -> 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            kv_inspect.fetch_dump(base, "nope")
+        assert e.value.code == 404
+    finally:
+        srv.stop()

@@ -1,0 +1,129 @@
+"""Inspect a live decode server's paged KV pool over HTTP.
+
+Usage::
+
+    python tools/kv_inspect.py http://HOST:PORT                # all decode models
+    python tools/kv_inspect.py http://HOST:PORT --model NAME   # one model
+    python tools/kv_inspect.py ... --verify                    # exit 1 on violations
+    python tools/kv_inspect.py ... --json                      # machine output
+
+The decode-serving sibling of ``tools/ckpt_inspect.py``: where that tool
+re-hashes checkpoint chunks on disk, this one reads the scheduler's
+``GET /api/<model>/kv`` snapshot — resident prefixes with refcounts, the
+refcount-0 LRU cache, dedupe counters, and the pool's own invariant
+check (free + live + shared + cached == capacity, no block in two
+domains, no session referencing an unallocated block).  ``--verify``
+turns any violation into exit code 1, which is how the chaos drill
+(tools/serve_bench.py --chaos) asserts pool integrity on every replica
+after a fault run.
+"""
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def fetch_json(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def decode_models(base_url, timeout=10.0):
+    """Names of the registry's decode entries (the ones with a pool)."""
+    doc = fetch_json(base_url.rstrip("/") + "/models", timeout)
+    return sorted(name for name, desc in doc.get("models", doc).items()
+                  if isinstance(desc, dict)
+                  and desc.get("kind") == "decode")
+
+
+def fetch_dump(base_url, model, timeout=10.0):
+    return fetch_json("%s/api/%s/kv" % (base_url.rstrip("/"), model),
+                      timeout)
+
+
+def verify_dump(dump):
+    """Violation list for one kv_dump document (empty == healthy)."""
+    return list(dump.get("integrity", ()))
+
+
+def describe(dump):
+    lines = []
+    lines.append(
+        "pool %s: %d blocks x %d tokens  (%d free, %d private, "
+        "%d shared, %d cached)"
+        % (dump.get("model", "?"), dump["num_blocks"],
+           dump["block_size"], dump["free_blocks"],
+           dump["private_blocks"], len(dump["shared"]),
+           len(dump["cached"])))
+    lines.append(
+        "  prefix caching %s, chunk %s tokens; %d sequence(s) "
+        "decoding, %d mid-prefill"
+        % ("on" if dump.get("prefix_caching") else "off",
+           dump.get("prefill_chunk_tokens") or "-",
+           dump.get("active_sequences", 0),
+           dump.get("chunking_sessions", 0)))
+    lines.append(
+        "  reuse: %d hit(s), %d block(s) dedup'd of %d published "
+        "(ratio %.2f), %d evicted"
+        % (dump["prefix_hits"], dump["dedup_blocks"],
+           dump["published_blocks"], dump["dedup_ratio"],
+           dump["evicted_blocks"]))
+    for entry in dump["shared"]:
+        lines.append("  shared  block %4d  key %s  refcount %d"
+                     % (entry["block"], entry["key"],
+                        entry["refcount"]))
+    for entry in dump["cached"]:
+        lines.append("  cached  block %4d  key %s" %
+                     (entry["block"], entry["key"]))
+    for s in dump.get("sessions", ()):
+        lines.append(
+            "  session %s  row %d  %d block(s) (%d shared)  "
+            "length %d  prefilled %d"
+            % (s["session_id"], s["row"], len(s["blocks"]),
+               s["shared_blocks"], s["length"], s["prefilled"]))
+    problems = verify_dump(dump)
+    lines.append("integrity: %s"
+                 % ("ok" if not problems else "; ".join(problems)))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", help="decode server base URL "
+                                "(http://host:port)")
+    ap.add_argument("--model", help="inspect one model (default: every "
+                                    "decode model the registry lists)")
+    ap.add_argument("--verify", action="store_true",
+                    help="exit 1 if any pool invariant is violated")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON document instead of text")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    names = [args.model] if args.model else \
+        decode_models(args.url, args.timeout)
+    if not names:
+        print("no decode models at %s" % args.url, file=sys.stderr)
+        return 2
+    dumps, bad = {}, []
+    for name in names:
+        dump = fetch_dump(args.url, name, args.timeout)
+        dumps[name] = dump
+        bad.extend("%s: %s" % (name, v) for v in verify_dump(dump))
+
+    if args.json:
+        print(json.dumps({"pools": dumps, "violations": bad},
+                         indent=1, sort_keys=True))
+    else:
+        for name in names:
+            print(describe(dumps[name]))
+    if args.verify and bad:
+        for v in bad:
+            print("VIOLATION %s" % v, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -482,6 +482,119 @@ def run_decode_bench(seconds=2.0, n_requests=None, max_batch=8,
     return out
 
 
+# -- prefix / chunked-prefill mode --------------------------------------------
+
+
+def run_prefix_bench(shared_prefix=16, waves=10, long_prompts=3,
+                     prompt_len=64, chunk_tokens=8, followers=8,
+                     prefill_delay=0.002, cache_dir=None):
+    """The chunked-prefill + prefix-reuse acceptance probe (ISSUE 14).
+
+    Phase A — head-of-line blocking: a short request submitted behind
+    ``long_prompts`` long prefills, monolithic vs chunked, on the
+    toydecode stand-in with a pinned per-prompt-token prefill cost (the
+    ``sleep:`` philosophy — scheduling is what's measured, not XLA).
+    The short request's TTFT p99 must drop >= 3x when long prefills are
+    chunked and interleaved with decode.
+
+    Phase B — prefix reuse: one seed generation publishes its prompt
+    blocks, then ``followers`` sequences sharing a ``shared_prefix``-
+    token system prompt attach to them; reports the reused-block
+    fraction (> 0.5 acceptance) and the bitwise oracle check.
+    """
+    from veles_tpu.serving import DecodeScheduler
+    from veles_tpu.serving.toydecode import ToyDecodeModel
+
+    if cache_dir:
+        from veles_tpu.config import root
+        root.common.compile_cache.dir = cache_dir
+    out = {"prefix_shared_tokens": shared_prefix,
+           "prefix_chunk_tokens": chunk_tokens,
+           "prefix_long_prompts": long_prompts,
+           "prefix_prompt_len": prompt_len,
+           "prefix_waves": waves}
+
+    # -- phase A: short-request TTFT behind long prefills ---------------------
+    model = ToyDecodeModel(vocab=97, prefill_delay=prefill_delay)
+    rng = numpy.random.RandomState(7)
+    long_reqs = [rng.randint(1, 90, prompt_len).tolist()
+                 for _ in range(long_prompts)]
+    short_req = [3, 1, 4, 1]
+
+    def ttft_run(chunk):
+        scheduler = DecodeScheduler(
+            model, max_batch=long_prompts + 1, block_size=4,
+            max_prompt_len=prompt_len, max_new_tokens=8,
+            queue_limit=256,
+            prefill_chunk_tokens=chunk,
+            name="prefix_chunk%s" % (chunk or 0))
+        ttfts = []
+        try:
+            warm = scheduler.stats()["compiles"]
+            for _ in range(max(1, waves)):
+                futures = [scheduler.submit(p, 8) for p in long_reqs]
+                short = scheduler.submit(short_req, 8)
+                ttfts.append(short.result(120)["ttft_s"])
+                for f in futures:
+                    f.result(120)
+            post = scheduler.stats()["compiles"] - warm
+        finally:
+            scheduler.close(drain=True)
+        ttfts.sort()
+        pick = lambda q: ttfts[min(len(ttfts) - 1,  # noqa: E731
+                                   int(q * len(ttfts)))]
+        return pick(0.50), pick(0.99), post
+
+    mono_p50, mono_p99, _ = ttft_run(None)
+    chunk_p50, chunk_p99, chunk_post = ttft_run(chunk_tokens)
+    out["prefix_ttft_p50_monolithic_ms"] = round(mono_p50 * 1e3, 2)
+    out["prefix_ttft_p99_monolithic_ms"] = round(mono_p99 * 1e3, 2)
+    out["prefix_ttft_p50_chunked_ms"] = round(chunk_p50 * 1e3, 2)
+    out["prefix_ttft_p99_chunked_ms"] = round(chunk_p99 * 1e3, 2)
+    out["prefix_ttft_p99_speedup"] = round(mono_p99 / chunk_p99, 2) \
+        if chunk_p99 else None
+    out["prefix_chunked_post_warmup_compiles"] = chunk_post
+
+    # -- phase B: shared-prefix block reuse -----------------------------------
+    model2 = ToyDecodeModel(vocab=97)
+    oracle = model2.generate_reference
+    prefix = [(11 * i + 5) % 89 + 1 for i in range(shared_prefix)]
+    block_size = 4
+    scheduler = DecodeScheduler(
+        model2, max_batch=4, block_size=block_size,
+        max_prompt_len=shared_prefix + 8, max_new_tokens=8,
+        queue_limit=256, prefix_caching=True,
+        prefill_chunk_tokens=chunk_tokens, name="prefix_reuse")
+    try:
+        warm_compiles = scheduler.stats()["compiles"]
+        seed_prompt = prefix + [91]
+        assert scheduler.submit(seed_prompt, 8).result(120)["tokens"] \
+            == oracle(seed_prompt, 8)
+        mismatches = 0
+        fut = [(prefix + [40 + i, 41 + i, 42 + i],
+                scheduler.submit(prefix + [40 + i, 41 + i, 42 + i], 8))
+               for i in range(followers)]
+        for prompt, f in fut:
+            if f.result(120)["tokens"] != oracle(prompt, 8):
+                mismatches += 1
+        stats = scheduler.stats()
+    finally:
+        scheduler.close(drain=True)
+    blocks_per_follower = -(-(shared_prefix + 3) // block_size)
+    out["prefix_followers"] = followers
+    out["prefix_hits"] = stats["prefix_hits"]
+    out["prefix_dedup_blocks"] = stats["dedup_blocks"]
+    out["prefix_published_blocks"] = stats["published_blocks"]
+    out["prefix_reused_fraction"] = round(
+        stats["dedup_blocks"] / (followers * blocks_per_follower), 3)
+    out["prefix_token_mismatches"] = mismatches
+    out["prefix_tokens_match"] = mismatches == 0
+    out["prefix_compiles"] = stats["compiles"]
+    out["prefix_post_warmup_compiles"] = (stats["compiles"]
+                                          - warm_compiles)
+    return out
+
+
 # -- fleet load mode ----------------------------------------------------------
 #
 # The multi-replica counterpart (ISSUE 7): the SAME open/closed-loop
@@ -704,6 +817,29 @@ def run_fleet_bench(replicas=3, clients=None, seconds=2.0,
     return out
 
 
+def _post_json(port, route, payload, timeout=30):
+    """One JSON POST to the local router; → (status, parsed body)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", route, json.dumps(payload).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = resp.read()
+        status = resp.status
+    finally:
+        conn.close()
+    try:
+        return status, json.loads(body or b"{}")
+    except ValueError:
+        return status, {}
+
+
+# the chaos fleet's decode model: prefix caching + chunked prefill ON,
+# so the fault run also exercises the deduped-pool/chunk-queue paths
+CHAOS_KV_SPEC = ("toydecode:vocab=97,delay=0.0,max_batch=4,block=4,"
+                 "max_prompt=16,max_new=8,chunk=4,prefix=1")
+
+
 def run_chaos_bench(replicas=3, package=None, offered_rps=40.0,
                     drill_seconds=10.0, sizes=DEFAULT_SIZES,
                     max_batch=16, cache_dir=None):
@@ -712,9 +848,18 @@ def run_chaos_bench(replicas=3, package=None, offered_rps=40.0,
     response truncation, connection black-hole, SIGSTOP freeze — under
     a deadline-carrying open loop.  The bar: ``chaos_failed == 0``
     (every response is 200, backpressure, or a deadline 504), plus the
-    kill→ready-again recovery seconds in the bench JSON."""
+    kill→ready-again recovery seconds in the bench JSON.
+
+    Every replica also hosts a prefix-caching decode model
+    (``CHAOS_KV_SPEC``) fed shared-prefix generate traffic through the
+    same fault window; after the drill each surviving pool is fetched
+    via ``GET /api/kv/kv`` and checked with tools/kv_inspect — the
+    ``chaos_kv_violations`` list must stay empty and every 200 response
+    must match the host oracle bitwise."""
     import shutil
     from veles_tpu.fleet import Fleet
+    from veles_tpu.serving.toydecode import ToyDecodeModel
+    from tools import kv_inspect
 
     tmp = None
     if package is None:
@@ -742,7 +887,8 @@ def run_chaos_bench(replicas=3, package=None, offered_rps=40.0,
            "chaos_offered_rps": offered_rps,
            "chaos_seconds": drill_seconds}
     t0 = time.perf_counter()
-    fleet = Fleet({"mnist": package}, replicas=replicas,
+    fleet = Fleet({"mnist": package, "kv": CHAOS_KV_SPEC},
+                  replicas=replicas,
                   max_batch=max_batch, cache_dir=cache_dir,
                   poll_interval=0.1, fault_plans=plans,
                   backoff={"base": 0.2, "factor": 2.0, "cap": 5.0,
@@ -750,6 +896,37 @@ def run_chaos_bench(replicas=3, package=None, offered_rps=40.0,
     fleet.start(ready_timeout=300)
     out["chaos_start_s"] = round(time.perf_counter() - t0, 2)
     try:
+        # shared-prefix decode traffic riding the same fault window:
+        # availability may dip (that is the drill), correctness may not
+        kv_out = {"ok": 0, "shed": 0, "failed": 0, "mismatch": 0}
+        kv_stop = threading.Event()
+        kv_oracle = ToyDecodeModel(vocab=97).generate_reference
+
+        def kv_traffic():
+            prefix = list(range(1, 9))   # one system prompt, many tails
+            k = 0
+            while not kv_stop.is_set():
+                prompt = prefix + [10 + (k % 5)]
+                k += 1
+                try:
+                    status, body = _post_json(
+                        fleet.port, "/api/kv/generate",
+                        {"prompt": prompt, "max_new_tokens": 8})
+                except Exception:
+                    status, body = -1, {}
+                if status == 200:
+                    if body.get("tokens") == kv_oracle(prompt, 8):
+                        kv_out["ok"] += 1
+                    else:
+                        kv_out["mismatch"] += 1
+                elif status in (429, 503, 504):
+                    kv_out["shed"] += 1
+                else:
+                    kv_out["failed"] += 1
+                if kv_stop.wait(0.25):
+                    break
+        kv_thread = threading.Thread(target=kv_traffic)
+        kv_thread.start()
         # sample replica state through the drill: recovery = the first
         # down transition of the SIGKILLed replica → ready again
         down_at = {}
@@ -781,6 +958,37 @@ def run_chaos_bench(replicas=3, package=None, offered_rps=40.0,
             time.sleep(0.1)
         sampling.set()
         sampler.join()
+        kv_stop.set()
+        kv_thread.join()
+
+        # pool integrity on every surviving replica, straight at the
+        # replica ports (the same sweep `kv_inspect --verify` runs)
+        kv_violations = []
+        kv_pools = kv_hits = kv_dedup = 0
+        for rid in fleet.router.replica_ids():
+            rep = fleet.router.replica(rid)
+            if rep is None or not (rep.up and rep.ready):
+                continue
+            base = "http://%s:%d" % (rep.host, rep.port)
+            try:
+                dump = kv_inspect.fetch_dump(base, "kv")
+            except Exception as e:
+                kv_violations.append("%s: kv dump unreachable (%s)"
+                                     % (rid, e))
+                continue
+            kv_pools += 1
+            kv_hits += dump.get("prefix_hits", 0)
+            kv_dedup += dump.get("dedup_blocks", 0)
+            kv_violations.extend("%s: %s" % (rid, v)
+                                 for v in kv_inspect.verify_dump(dump))
+        out["chaos_kv_ok"] = kv_out["ok"]
+        out["chaos_kv_shed"] = kv_out["shed"]
+        out["chaos_kv_failed"] = kv_out["failed"]
+        out["chaos_kv_mismatch"] = kv_out["mismatch"]
+        out["chaos_kv_pools_checked"] = kv_pools
+        out["chaos_kv_prefix_hits"] = kv_hits
+        out["chaos_kv_dedup_blocks"] = kv_dedup
+        out["chaos_kv_violations"] = kv_violations
         out["chaos_ok"] = drill["ok"]
         out["chaos_shed"] = drill["shed"]
         out["chaos_expired"] = drill["expired"]
@@ -845,6 +1053,15 @@ def main(argv=None):
     p.add_argument("--decode-max-prompt", type=int, default=16)
     p.add_argument("--decode-max-new", type=int, default=16)
     p.add_argument("--decode-requests", type=int, default=None)
+    p.add_argument("--shared-prefix", type=int, default=None,
+                   metavar="N",
+                   help="prefix/chunked-prefill mode: short-request "
+                        "TTFT behind long prefills (monolithic vs "
+                        "chunked) plus block dedupe across sequences "
+                        "sharing an N-token system prompt")
+    p.add_argument("--prefix-waves", type=int, default=10,
+                   help="head-of-line waves per variant "
+                        "(--shared-prefix mode)")
     p.add_argument("--cache-dir", default=None,
                    help="persistent executable cache dir (decode mode; "
                         "run twice to prove the zero-recompile warm "
@@ -884,6 +1101,17 @@ def main(argv=None):
                      out.get("chaos_retries"),
                      out.get("chaos_breaker_trips"),
                      out.get("chaos_restarts")), file=sys.stderr)
+            print("chaos kv: ok=%s shed=%s failed=%s MISMATCH=%s; "
+                  "%s pool(s) checked, %s prefix hits / %s blocks "
+                  "dedup'd, violations=%s"
+                  % (out.get("chaos_kv_ok"), out.get("chaos_kv_shed"),
+                     out.get("chaos_kv_failed"),
+                     out.get("chaos_kv_mismatch"),
+                     out.get("chaos_kv_pools_checked"),
+                     out.get("chaos_kv_prefix_hits"),
+                     out.get("chaos_kv_dedup_blocks"),
+                     out.get("chaos_kv_violations") or "none"),
+                  file=sys.stderr)
         print(json.dumps(line))
         return 0
 
@@ -909,6 +1137,31 @@ def main(argv=None):
                      out.get("fleet_respawn_compiles"),
                      out.get("fleet_rollout_failed"),
                      out.get("fleet_rollout_s")), file=sys.stderr)
+        print(json.dumps(line))
+        return 0
+
+    if args.shared_prefix:
+        out = run_prefix_bench(shared_prefix=args.shared_prefix,
+                               waves=args.prefix_waves,
+                               cache_dir=args.cache_dir)
+        line = {"metric": "prefix_ttft_p99_speedup",
+                "value": out.get("prefix_ttft_p99_speedup"),
+                "unit": "x"}
+        line.update(out)
+        if not args.json:
+            print("prefix bench: short-request TTFT p99 %s ms "
+                  "monolithic vs %s ms chunked (%sx); %s%% of follower "
+                  "blocks reused (%s hits, %s dedup'd), oracle match=%s,"
+                  " %s post-warmup compiles"
+                  % (out.get("prefix_ttft_p99_monolithic_ms"),
+                     out.get("prefix_ttft_p99_chunked_ms"),
+                     out.get("prefix_ttft_p99_speedup"),
+                     round(100 * out.get("prefix_reused_fraction", 0)),
+                     out.get("prefix_hits"),
+                     out.get("prefix_dedup_blocks"),
+                     out.get("prefix_tokens_match"),
+                     out.get("prefix_post_warmup_compiles")),
+                  file=sys.stderr)
         print(json.dumps(line))
         return 0
 

@@ -315,6 +315,65 @@ def probe_serving_decode(config, ctx, reps, windows):
             "cand_s": cand_s, "ref_s": ref_s}
 
 
+def probe_prefill_chunk(config, ctx, reps, windows):
+    """Short-request TTFT behind long chunked prefills — the quantity
+    the chunk size actually trades (smaller chunks interleave sooner,
+    but each chunk pays a dispatch) — gated on token-exactness vs the
+    cache-free oracle.  Runs on the toydecode stand-in with a pinned
+    per-prompt-token prefill cost so scheduling, not XLA, is what's
+    measured."""
+    import numpy
+    from veles_tpu.serving import DecodeScheduler
+    from veles_tpu.serving.toydecode import ToyDecodeModel
+    max_prompt = int(ctx.get("max_prompt_len", 64))
+    longs = int(ctx.get("long_prompts", 2))
+    pdelay = float(ctx.get("prefill_delay", 0.001))
+    model = ToyDecodeModel(vocab=97, prefill_delay=pdelay)
+    rng = numpy.random.RandomState(int(ctx.get("seed", 0)))
+    long_prompts = [rng.randint(1, 90, max_prompt).tolist()
+                    for _ in range(longs)]
+    short = [3, 1, 4, 1]
+
+    def build(chunk):
+        return DecodeScheduler(
+            model, max_batch=longs + 1, block_size=4,
+            max_prompt_len=max_prompt, max_new_tokens=4,
+            queue_limit=64, warmup=True, cache=False,
+            prefill_chunk_tokens=int(chunk),
+            name="autotune-chunk%d" % chunk)
+
+    def wave(s):
+        futs = [s.submit(p, 4) for p in long_prompts]
+        got = s.submit(short, 4).result(120)
+        for f in futs:
+            f.result(120)
+        return got
+
+    from veles_tpu.autotune.space import site
+    cand = build(config["chunk_tokens"])
+    ref = build(site("serving.prefill_chunk").default["chunk_tokens"])
+    try:
+        ok = wave(cand)["tokens"] == model.generate_reference(short, 4)
+        # the _timed_pair discipline (interleaved min-of-windows)
+        # applied to the short request's TTFT rather than wall time
+        cand_t, ref_t = [], []
+        for w in range(max(int(windows), 1)):
+            pairs = [(cand, cand_t), (ref, ref_t)]
+            if w % 2:
+                pairs.reverse()
+            for s, acc in pairs:
+                vals = [wave(s)["ttft_s"]
+                        for _ in range(max(int(reps), 1))]
+                acc.append(sum(vals) / len(vals))
+        cand_s, ref_s = min(cand_t), min(ref_t)
+    finally:
+        cand.close(drain=False)
+        ref.close(drain=False)
+    return {"gate": _gate(ok, "tokens diverge from the cache-free "
+                              "oracle"),
+            "cand_s": cand_s, "ref_s": ref_s}
+
+
 _IMPLS = {
     "lrn": probe_lrn,
     "flash_attention": probe_flash_attention,
@@ -323,11 +382,14 @@ _IMPLS = {
     "paged_attention": probe_paged_attention,
     "serving.bucket_ladder": probe_bucket_ladder,
     "serving.decode": probe_serving_decode,
+    "serving.prefill_chunk": probe_prefill_chunk,
 }
 
 #: cheap serving probes need fewer reps than μs-scale kernels
-_DEFAULT_REPS = {"serving.bucket_ladder": 1, "serving.decode": 1}
-_DEFAULT_WINDOWS = {"serving.bucket_ladder": 2, "serving.decode": 2}
+_DEFAULT_REPS = {"serving.bucket_ladder": 1, "serving.decode": 1,
+                 "serving.prefill_chunk": 1}
+_DEFAULT_WINDOWS = {"serving.bucket_ladder": 2, "serving.decode": 2,
+                    "serving.prefill_chunk": 2}
 
 
 def main(argv=None):

@@ -7,7 +7,7 @@ same: the site declares *every* candidate up front with validity
 constraints, the runner measures, and only a measured, correctness-
 gated winner is ever persisted.
 
-Seven builtin sites cover the tree's tunables:
+Eight builtin sites cover the tree's tunables:
 
 ==================== ======================================== ===========
 site                 parameters                               dispatch at
@@ -19,6 +19,7 @@ precise_gemm         block_m, block_n, block_k                znicz/gemm.py
 paged_attention      block_size                               serving/decode.py
 serving.bucket_ladder shape (pow2|coarse|dense)               serving/scheduler.py
 serving.decode       max_batch, block_size                    serving/decode.py
+serving.prefill_chunk chunk_tokens                            serving/decode.py
 ==================== ======================================== ===========
 
 Every site's ``default`` is the exact hand-picked configuration the
@@ -233,6 +234,25 @@ _register(SearchSpace(
     classify=lambda ctx: "ctx%d" % pow2_bucket(ctx.get("max_context", 64)),
     description="decode scheduler geometry: concurrent rows + KV page "
                 "size"))
+
+
+def _chunk_constraint(cfg, ctx):
+    # a chunk larger than the prompt ceiling degenerates to monolithic
+    # prefill with extra padding — keep candidates distinct
+    mp = ctx.get("max_prompt_len")
+    return mp is None or cfg["chunk_tokens"] <= pow2_bucket(mp)
+
+
+_register(SearchSpace(
+    "serving.prefill_chunk",
+    params={"chunk_tokens": (8, 16, 32, 64)},
+    default={"chunk_tokens": 32},    # decode.DEFAULT_PREFILL_CHUNK
+    constraint=_chunk_constraint,
+    classify=lambda ctx: "mp%d" % pow2_bucket(
+        ctx.get("max_prompt_len", 64)),
+    description="prefill chunk size: short-request TTFT under "
+                "head-of-line long prefills vs per-chunk dispatch "
+                "overhead"))
 
 
 def site(name):

@@ -17,7 +17,18 @@ every request —
   manifest);
 - prompt prefill goes through a power-of-two length ladder (the same
   bucket discipline — and the same persistent-executable plumbing — as
-  the request path), one sequence per prefill;
+  the request path), one sequence per prefill; with
+  ``prefill_chunk_tokens`` set, prefill instead runs through ONE warm
+  fixed-size chunk executable, one chunk per worker iteration,
+  interleaved with decode steps — a long prompt no longer stalls the
+  batch for a monolithic ladder call (the TTFT-vs-throughput tension
+  ragged paged attention exists to resolve);
+- with ``prefix_caching`` on, the block pool is content-addressed over
+  token-prefix hashes (:func:`.kvcache.key_chain`): admission attaches
+  to already-resident blocks via refcounts and prefills only the
+  non-resident suffix, chunk by chunk — shared system prompts and
+  multi-turn re-submissions skip most of their prefill.  Both knobs
+  default OFF, which is bit-for-bit the historical behavior;
 - K/V lives in fixed-size blocks of a preallocated device pool
   (:mod:`.kvcache` owns placement; znicz/paged_attention.py gathers
   through the page table), so memory is allocated per sequence LENGTH,
@@ -45,7 +56,7 @@ import numpy
 from ..compilecache import WarmupManifest, default_cache
 from ..logger import events
 from ..observability import trace as _trace
-from .kvcache import KVBlockPool, required_blocks
+from .kvcache import KVBlockPool, key_chain, required_blocks
 from .metrics import DecodeMetrics
 from .scheduler import (DeadlineExpired, SchedulerClosed,
                         SchedulerOverflow, bucket_sizes,
@@ -56,6 +67,10 @@ _STOP = object()
 #: completed results kept for session re-attach (router failover /
 #: migration races land the client's follow-up after completion)
 _FINISHED_KEEP = 256
+
+#: hand-picked prefill chunk size (tokens per chunk executable call) —
+#: the ``serving.prefill_chunk`` autotune site's baseline candidate
+DEFAULT_PREFILL_CHUNK = 32
 
 
 class _Request:
@@ -90,7 +105,7 @@ class _Session:
     """One admitted sequence: its row, blocks, and token state."""
 
     __slots__ = ("req", "row", "blocks", "length", "next_input",
-                 "generated", "first_token_s")
+                 "generated", "first_token_s", "shared", "prefilled")
 
     def __init__(self, req, row, blocks):
         self.req = req
@@ -100,6 +115,8 @@ class _Session:
         self.next_input = 0      # last emitted token (next step's input)
         self.generated = []
         self.first_token_s = None
+        self.shared = 0          # leading blocks attached already-resident
+        self.prefilled = 0       # prompt tokens prefilled so far (chunked)
 
     @property
     def done(self):
@@ -125,13 +142,43 @@ class DecodeScheduler:
     def __init__(self, model, *, max_batch=None, block_size=None,
                  max_prompt_len=32, max_new_tokens=32, num_blocks=None,
                  queue_limit=64, name="decode", metrics=None,
-                 cache=None, manifest=None, warmup=True):
+                 cache=None, manifest=None, warmup=True,
+                 prefix_caching=False, prefill_chunk_tokens=None):
         self.name = name
         self.model = model
         self.max_prompt_len = int(max_prompt_len)
         self.max_new_tokens = int(max_new_tokens)
         self.queue_limit = int(queue_limit)
         self.max_context = self.max_prompt_len + self.max_new_tokens
+        # prefill chunking is a TUNABLE SITE too (serving.prefill_chunk):
+        # an int pins the chunk size, "auto" consults the tuning store,
+        # None (default) keeps the monolithic bucket-ladder path exactly
+        self.prefix_caching = bool(prefix_caching)
+        self._chunk_source = None
+        chunk = prefill_chunk_tokens
+        if chunk == "auto":
+            from ..autotune import dispatch as _autotune
+            from ..autotune.space import pow2_bucket
+            cfg_c, self._chunk_source = _autotune.resolve(
+                "serving.prefill_chunk",
+                "mp%d" % pow2_bucket(self.max_prompt_len),
+                default={"chunk_tokens": DEFAULT_PREFILL_CHUNK})
+            chunk = cfg_c["chunk_tokens"]
+        elif chunk is not None:
+            self._chunk_source = "explicit"
+        self.chunk_tokens = int(chunk) if chunk else None
+        if self.chunk_tokens is not None and self.chunk_tokens < 1:
+            raise ValueError("prefill_chunk_tokens must be >= 1")
+        if self.prefix_caching and not self.chunk_tokens:
+            raise ValueError(
+                "prefix_caching=True requires prefill_chunk_tokens — "
+                "the chunked path is what admits partially-resident "
+                "prompts (suffix-only prefill)")
+        if self.chunk_tokens and not hasattr(model,
+                                             "prefill_chunk_fn"):
+            raise ValueError(
+                "model %r has no prefill_chunk_fn; chunked prefill "
+                "is unavailable for it" % getattr(model, "name", model))
         # the decode geometry is a TUNABLE SITE (serving.decode):
         # explicit kwargs pin it; otherwise a tuning record for this
         # context-length class picks the measured (max_batch,
@@ -160,7 +207,8 @@ class DecodeScheduler:
             num_blocks = self.max_batch * self.max_blocks + 1
         self.metrics = metrics or DecodeMetrics(name)
         self.prefill_buckets = bucket_sizes(self.max_prompt_len)
-        self._pool = KVBlockPool(num_blocks, self.block_size)
+        self._pool = KVBlockPool(num_blocks, self.block_size,
+                                 prefix_caching=self.prefix_caching)
         if not self._pool.fits(self.max_context):
             raise ValueError(
                 "num_blocks=%d cannot hold even one max-context "
@@ -175,7 +223,8 @@ class DecodeScheduler:
                                      numpy.int32)
         self._np_lengths = numpy.zeros(self.max_batch, numpy.int32)
         self._np_tokens = numpy.zeros(self.max_batch, numpy.int32)
-        self._sessions = {}          # row -> _Session
+        self._sessions = {}          # row -> _Session (decoding)
+        self._chunking = collections.deque()   # _Session mid-prefill
         self._by_sid = {}            # session id -> live _Session
         self._migrating = {}         # session id -> parked Future
         self._finished = collections.OrderedDict()  # sid -> result (LRU)
@@ -193,7 +242,13 @@ class DecodeScheduler:
                                    donate_argnums=(0, 1))
         self._prefill_jit = jax.jit(model.prefill_fn(self.block_size),
                                     donate_argnums=(2, 3))
+        self._chunk_jit = None
+        if self.chunk_tokens:
+            self._chunk_jit = jax.jit(
+                model.prefill_chunk_fn(self.block_size),
+                donate_argnums=(3, 4))
         self._decode_exe = None
+        self._chunk_exe = None
         self._prefill_exes = {}
         self._compiles = 0
         self._cache_hits = 0
@@ -218,6 +273,10 @@ class DecodeScheduler:
                 self.name, "serving.decode",
                 {"max_batch": self.max_batch,
                  "block_size": self.block_size})
+        if self._manifest is not None and self._chunk_source == "tuned":
+            self._manifest.record_config(
+                self.name, "serving.prefill_chunk",
+                {"chunk_tokens": self.chunk_tokens})
         self._warmed = False
         if warmup:
             self.warmup()
@@ -295,6 +354,27 @@ class DecodeScheduler:
                                               bucket)
         return exe
 
+    def _get_chunk_exe(self):
+        if self._chunk_exe is None:
+            with self._compile_lock:
+                if self._chunk_exe is None:
+                    jax = self._jax
+                    kps, vps = self._pool_structs()
+                    self._chunk_exe = self._aot(
+                        self._chunk_jit,
+                        jax.ShapeDtypeStruct((self.chunk_tokens,),
+                                             numpy.int32),
+                        jax.ShapeDtypeStruct((), numpy.int32),
+                        jax.ShapeDtypeStruct((), numpy.int32),
+                        kps, vps,
+                        jax.ShapeDtypeStruct((self.max_blocks,),
+                                             numpy.int32),
+                        tag="chunk%d" % self.chunk_tokens)
+                    if self._manifest is not None:
+                        self._manifest.record(self.name + "@chunk",
+                                              self.chunk_tokens)
+        return self._chunk_exe
+
     def _warmup_order(self):
         order = list(self.prefill_buckets)
         if self._manifest is None:
@@ -305,12 +385,17 @@ class DecodeScheduler:
         return first + [b for b in order if b not in first]
 
     def warmup(self):
-        """Compile the decode step and the whole prefill ladder up
-        front (manifest-recorded buckets first) so steady state never
-        compiles."""
+        """Compile the decode step and the whole prefill path up front
+        so steady state never compiles.  Chunked mode replaces the
+        whole prefill ladder with ONE chunk executable (every chunk of
+        every prompt runs through it) — one more AOT entry in the
+        warmup manifest, one less reason for a restart to compile."""
         self._get_decode_exe()
-        for b in self._warmup_order():
-            self._get_prefill_exe(b)
+        if self.chunk_tokens:
+            self._get_chunk_exe()
+        else:
+            for b in self._warmup_order():
+                self._get_prefill_exe(b)
         self._warmup_compiles = self._compiles
         self._warmed = True
 
@@ -376,7 +461,8 @@ class DecodeScheduler:
     def _worker_loop(self):
         stop = False
         while True:
-            block = not self._sessions and not self._pending and not stop
+            block = (not self._sessions and not self._chunking
+                     and not self._pending and not stop)
             while True:
                 try:
                     item = self._queue.get(block=block, timeout=None) \
@@ -400,9 +486,14 @@ class DecodeScheduler:
                 self._cancel_all()
                 return
             self._admit()
+            # THE interleave: one prefill chunk, then one decode step —
+            # a long prompt advances without ever stalling live rows
+            # for more than one chunk's worth of device time
+            if self._chunking:
+                self._chunk_step()
             if self._sessions:
                 self._step()
-            elif stop and not self._pending:
+            elif stop and not self._pending and not self._chunking:
                 return
 
     def _fail(self, req, exc):
@@ -425,6 +516,11 @@ class DecodeScheduler:
                 break
             if item is not _STOP:
                 self._fail(item, exc)
+        while self._chunking:
+            session = self._chunking.popleft()
+            self._by_sid.pop(session.req.sid, None)
+            self._release_session_blocks(session, publish=False)
+            self._fail(session.req, exc)
         for row in list(self._sessions):
             session = self._sessions[row]
             self._retire(session, error=exc)
@@ -435,8 +531,9 @@ class DecodeScheduler:
 
     # -- admission / prefill -------------------------------------------------
     def _free_rows(self):
-        return [r for r in range(self.max_batch)
-                if r not in self._sessions]
+        busy = set(self._sessions)
+        busy.update(s.row for s in self._chunking)
+        return [r for r in range(self.max_batch) if r not in busy]
 
     def _admit(self):
         # shed queue-expired work FIRST: a request whose deadline passed
@@ -459,6 +556,10 @@ class DecodeScheduler:
             req = self._pending[0]
             need = required_blocks(
                 len(req.prompt) + req.max_new_tokens, self.block_size)
+            if self.chunk_tokens:
+                if not self._admit_chunked(req, need, rows):
+                    break           # head-of-line waits for retirements
+                continue
             blocks = self._pool.alloc(need)
             if blocks is None:
                 break               # head-of-line waits for retirements
@@ -479,9 +580,148 @@ class DecodeScheduler:
             if session.done:        # max_new_tokens == 1: prefill was all
                 self._retire(session)
                 rows.insert(0, row)
+        self.metrics.set_chunk_queue(len(self._chunking))
         self.metrics.set_occupancy(
             len(self._sessions), self._pool.live_blocks /
             max(self._pool.capacity, 1))
+
+    def _admit_chunked(self, req, need, rows):
+        """Admit the head-of-line request onto the chunked path: attach
+        the resident prefix (refcounted, suffix-only prefill), allocate
+        the rest as private blocks, queue the session for chunk steps.
+        Returns False when the pool cannot serve it yet."""
+        length = len(req.prompt)
+        matched = []
+        if self.prefix_caching:
+            # never match the whole prompt: the first output token
+            # needs the hidden state at position length-1, which only
+            # a prefill of >= 1 suffix token computes
+            keys = key_chain(req.prompt,
+                             self.block_size)[:(length - 1) //
+                                              self.block_size]
+            matched = self._pool.acquire_prefix(keys)
+        private = self._pool.alloc(need - len(matched))
+        if private is None:
+            if matched:
+                self._pool.release(matched)
+            return False
+        self._pending.popleft()
+        row = rows.pop(0)
+        session = _Session(req, row, list(matched) + private)
+        session.shared = len(matched)
+        session.prefilled = len(matched) * self.block_size
+        # the page-table row stays zeroed (trash) until the final chunk
+        # lands: decode steps must treat this row as padding, and a
+        # stray write must never touch a shared block
+        self._chunking.append(session)
+        self._by_sid[req.sid] = session
+        self.metrics.record_admit(length,
+                                  prefilled=length - session.prefilled)
+        self.metrics.record_prefix(len(matched))
+        return True
+
+    def _chunk_step(self):
+        """Advance the oldest prefilling session by ONE chunk through
+        the warm chunk executable; on the final chunk the session
+        becomes a decode row."""
+        session = self._chunking.popleft()
+        req = session.req
+        length = len(req.prompt)
+        start = session.prefilled
+        end = min(start + self.chunk_tokens, length)
+        tokens = numpy.zeros(self.chunk_tokens, numpy.int32)
+        tokens[:end - start] = req.prompt[start:end]
+        block_row = numpy.zeros(self.max_blocks, numpy.int32)
+        block_row[:len(session.blocks)] = session.blocks
+        run = self._get_chunk_exe()
+        t0 = time.perf_counter()
+        try:
+            first, self._k_pools, self._v_pools = run(
+                tokens, numpy.int32(start), numpy.int32(length),
+                self._k_pools, self._v_pools, block_row)
+            if end >= length:
+                first = int(first)   # D2H sync only on the final chunk
+        except Exception as exc:  # noqa: BLE001 — fail THIS request
+            self._by_sid.pop(req.sid, None)
+            self._release_session_blocks(session, publish=False)
+            self._fail(req, exc)
+            return
+        # per-token stand-in cost: a chunk blocks the loop only for its
+        # OWN tokens (and resident prefix tokens cost nothing at all)
+        delay = getattr(self.model, "prefill_host_delay", 0)
+        if delay:
+            time.sleep(delay * (end - start))
+        dt = time.perf_counter() - t0
+        session.prefilled = end
+        self.metrics.record_chunk()
+        events.span("serving.prefill_chunk", dt, model=self.name,
+                    start=int(start), prompt_tokens=int(length))
+        if end < length:
+            self._chunking.append(session)
+            return
+        session.length = length
+        session.next_input = first
+        session.generated.append(first)
+        session.first_token_s = time.perf_counter() - req.enqueued
+        self._np_table[session.row, :] = 0
+        self._np_table[session.row, :len(session.blocks)] = \
+            session.blocks
+        self._np_lengths[session.row] = length
+        self._np_tokens[session.row] = first
+        self._sessions[session.row] = session
+        self.metrics.record_first_token(
+            session.first_token_s,
+            resident=session.shared * self.block_size / length)
+        self._publish_prompt(session)
+        if session.done:            # max_new_tokens == 1
+            self._retire(session)
+        self.metrics.set_chunk_queue(len(self._chunking))
+
+    # -- prefix publication / block release ----------------------------------
+    def _publish_prompt(self, session):
+        """Make the session's full PROMPT blocks addressable the moment
+        its prefill completes — sequences arriving while it decodes
+        already match them."""
+        if not self.prefix_caching:
+            return
+        keys = key_chain(session.req.prompt, self.block_size)
+        for i, key in enumerate(keys):
+            block = session.blocks[i]
+            if not self._pool.is_shared(block):
+                # first writer wins; on a key collision ours stays a
+                # private copy and dies with the session
+                self._pool.publish(block, key)
+
+    def _publish_history(self, session):
+        """At successful retire, publish the full blocks of the entire
+        history (prompt + generated) — a multi-turn follow-up that
+        re-submits this conversation attaches to them."""
+        history = list(session.req.prompt) + session.generated[:-1]
+        keys = key_chain(history, self.block_size)
+        for i, key in enumerate(keys):
+            if i >= len(session.blocks):
+                break
+            block = session.blocks[i]
+            if not self._pool.is_shared(block):
+                self._pool.publish(block, key)
+
+    def _release_session_blocks(self, session, publish):
+        """Give a leaving session's blocks back: shared ones drop a
+        reference (content stays resident), private ones return to the
+        free list — optionally publishing the history first so the
+        content remains addressable."""
+        if not self.prefix_caching:
+            self._pool.free(session.blocks)
+            return
+        if publish:
+            self._publish_history(session)
+        shared = [b for b in session.blocks if self._pool.is_shared(b)]
+        private = [b for b in session.blocks
+                   if not self._pool.is_shared(b)]
+        if shared:
+            self._pool.release(shared)
+        if private:
+            self._pool.free(private)
 
     def _prefill(self, session):
         req = session.req
@@ -497,6 +737,12 @@ class DecodeScheduler:
             tokens, numpy.int32(length), self._k_pools, self._v_pools,
             block_row)
         first = int(first)
+        # stand-in hook (the ``sleep:`` philosophy): pin prefill wall
+        # time per PROMPT TOKEN so monolithic-vs-chunked head-of-line
+        # blocking is measurable without XLA cost
+        delay = getattr(self.model, "prefill_host_delay", 0)
+        if delay:
+            time.sleep(delay * length)
         dt = time.perf_counter() - t0
         session.length = length
         session.next_input = first
@@ -541,7 +787,7 @@ class DecodeScheduler:
     def _retire(self, session, error=None):
         self._sessions.pop(session.row, None)
         self._by_sid.pop(session.req.sid, None)
-        self._pool.free(session.blocks)
+        self._release_session_blocks(session, publish=error is None)
         self._np_table[session.row, :] = 0
         self._np_lengths[session.row] = 0
         self._np_tokens[session.row] = 0
@@ -601,6 +847,10 @@ class DecodeScheduler:
 
     def _checkpoint_kv(self, directory, name):
         from ..checkpoint import save_state
+        # finish in-flight chunked prefills first: a session with half
+        # a prompt in the pool has no consistent cut to save
+        while self._chunking:
+            self._chunk_step()
         state = {
             "geometry": {
                 "max_batch": self.max_batch,
@@ -608,14 +858,14 @@ class DecodeScheduler:
                 "max_prompt_len": self.max_prompt_len,
                 "max_new_tokens": self.max_new_tokens,
                 "num_blocks": self._pool.num_blocks,
+                "prefix_caching": self.prefix_caching,
             },
             "k_pools": self._k_pools,
             "v_pools": self._v_pools,
             "table": self._np_table.copy(),
             "lengths": self._np_lengths.copy(),
             "tokens": self._np_tokens.copy(),
-            "pool": {"free": [int(b) for b in self._pool._free],
-                     "live": sorted(int(b) for b in self._pool._live)},
+            "pool": self._pool.state_dict(),
             "sessions": [{
                 "row": int(s.row),
                 "blocks": [int(b) for b in s.blocks],
@@ -625,6 +875,7 @@ class DecodeScheduler:
                 "first_token_s": float(s.first_token_s or 0.0),
                 "prompt": numpy.array(s.req.prompt),
                 "max_new_tokens": int(s.req.max_new_tokens),
+                "shared": int(s.shared),
             } for s in self._sessions.values()],
         }
         return save_state(directory, name, state,
@@ -643,7 +894,8 @@ class DecodeScheduler:
                 "block_size": self.block_size,
                 "max_prompt_len": self.max_prompt_len,
                 "max_new_tokens": self.max_new_tokens,
-                "num_blocks": self._pool.num_blocks}
+                "num_blocks": self._pool.num_blocks,
+                "prefix_caching": self.prefix_caching}
         if geo != mine:
             raise ValueError("geometry mismatch: checkpoint %s vs "
                              "scheduler %s" % (geo, mine))
@@ -655,8 +907,7 @@ class DecodeScheduler:
         self._np_table[:] = state["table"]
         self._np_lengths[:] = state["lengths"]
         self._np_tokens[:] = state["tokens"]
-        self._pool._free = [int(b) for b in state["pool"]["free"]]
-        self._pool._live = set(int(b) for b in state["pool"]["live"])
+        self._pool.load_state(state["pool"])
         futures = {}
         for saved in state["sessions"]:
             req = _Request(numpy.asarray(saved["prompt"], numpy.int32),
@@ -667,6 +918,7 @@ class DecodeScheduler:
             session.next_input = int(saved["next_input"])
             session.generated = [int(t) for t in saved["generated"]]
             session.first_token_s = saved["first_token_s"]
+            session.shared = int(saved.get("shared", 0))
             self._sessions[session.row] = session
             with self._depth_lock:
                 self._depth += 1
@@ -733,6 +985,42 @@ class DecodeScheduler:
             "migrating": sorted(self._migrating),
             "finished": list(self._finished)})
 
+    def kv_dump(self):
+        """Live-pool introspection for tools/kv_inspect.py: resident
+        prefixes, refcounts, dedupe ratio and an integrity verdict —
+        captured on the worker at a step boundary, so the snapshot is
+        self-consistent."""
+        return self._run_job(self._kv_dump)
+
+    def _kv_dump(self):
+        dump = self._pool.dump()
+        sessions = []
+        for session in (list(self._sessions.values())
+                        + list(self._chunking)):
+            sessions.append({
+                "session_id": session.req.sid,
+                "row": int(session.row),
+                "blocks": [int(b) for b in session.blocks],
+                "shared_blocks": int(session.shared),
+                "length": int(session.length),
+                "prefilled": int(session.prefilled),
+            })
+        problems = list(dump["integrity"])
+        allocated = self._pool._live | set(self._pool._refs)
+        for entry in sessions:
+            missing = [b for b in entry["blocks"] if b not in allocated]
+            if missing:
+                problems.append("session %s references unallocated "
+                                "block(s) %s"
+                                % (entry["session_id"], missing))
+        dump.update(model=self.name,
+                    prefill_chunk_tokens=self.chunk_tokens,
+                    active_sequences=len(self._sessions),
+                    chunking_sessions=len(self._chunking),
+                    sessions=sessions,
+                    integrity=problems)
+        return dump
+
     def spill_session(self, session_id, directory):
         """Spill one (idle) session to a host-side sharded checkpoint
         and free its row/blocks; any waiter gets a ``{"spilled": True}``
@@ -752,6 +1040,21 @@ class DecodeScheduler:
             if want is not None and session.req.sid not in want:
                 continue
             states.append(self._export_one(session))
+        # mid-prefill (chunking) sessions abandon their partial KV and
+        # travel as prompt-only states — the peer prefills them from
+        # scratch (or from ITS resident prefixes)
+        keep_chunking = collections.deque()
+        while self._chunking:
+            session = self._chunking.popleft()
+            if want is not None and session.req.sid not in want:
+                keep_chunking.append(session)
+                continue
+            self._by_sid.pop(session.req.sid, None)
+            self._release_session_blocks(session, publish=False)
+            states.append(self._fresh_state(session.req))
+            self._migrating[session.req.sid] = session.req.future
+            self._release()
+        self._chunking = keep_chunking
         # queued-but-unprefilled requests ride along as prompt-only
         # states (no KV yet — the peer prefills them from scratch)
         keep = collections.deque()
@@ -797,7 +1100,7 @@ class DecodeScheduler:
         })
         self._sessions.pop(session.row, None)
         self._by_sid.pop(req.sid, None)
-        self._pool.free(session.blocks)
+        self._release_session_blocks(session, publish=False)
         self._np_table[session.row, :] = 0
         self._np_lengths[session.row] = 0
         self._np_tokens[session.row] = 0
@@ -972,6 +1275,7 @@ class DecodeScheduler:
                 "queue_limit": self.queue_limit,
                 "utilization": round(depth / self.queue_limit, 4),
                 "active_rows": len(self._sessions),
+                "chunking_sessions": len(self._chunking),
                 "kv_occupancy": round(
                     self._pool.live_blocks /
                     max(self._pool.capacity, 1), 4)}
@@ -991,9 +1295,10 @@ class DecodeScheduler:
         """Zero-recompile evidence + occupancy, BucketScheduler-shaped
         (``compiles`` = fresh XLA only; warm restarts show 0)."""
         pool = self._pool.stats()
-        return {
+        out = {
             "buckets": list(self.prefill_buckets),
             "executables": (1 if self._decode_exe is not None else 0)
+            + (1 if self._chunk_exe is not None else 0)
             + len(self._prefill_exes),
             "compiles": self._compiles,
             "cache_hits": self._cache_hits,
@@ -1013,6 +1318,19 @@ class DecodeScheduler:
             "kv_utilization": pool["utilization"],
             "max_prompt_len": self.max_prompt_len,
             "max_new_tokens": self.max_new_tokens,
+            "prefix_caching": self.prefix_caching,
+            "prefill_chunk_tokens": self.chunk_tokens,
+            "chunking_sessions": len(self._chunking),
             "ready": self.ready,
             "closed": self._closed,
         }
+        if self._chunk_source is not None:
+            out["chunk_source"] = self._chunk_source
+        if self.prefix_caching:
+            out.update(prefix_hits=pool["prefix_hits"],
+                       dedup_blocks=pool["dedup_blocks"],
+                       published_blocks=pool["published_blocks"],
+                       evicted_blocks=pool["evicted_blocks"],
+                       shared_blocks=pool["shared_blocks"],
+                       cached_blocks=pool["cached_blocks"])
+        return out

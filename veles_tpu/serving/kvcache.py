@@ -16,14 +16,60 @@ it, and it is never handed to a live sequence — so a stray write can
 only ever land somewhere no real sequence reads (the isolation property
 tests/test_decode_serving.py asserts over random admit/retire
 schedules).
+
+Prefix caching (``prefix_caching=True``) makes the pool
+**content-addressed over token prefixes**, the same sha256 dedupe idiom
+``checkpoint/store.py`` proved for tensor chunks, applied to live KV
+blocks.  Each FULL block of a sequence's history is keyed by a rolling
+hash of (parent-block key, the block's tokens) — see :func:`key_chain` —
+so equal token prefixes map to equal key chains regardless of which
+sequence wrote them.  Blocks then move through three host-side domains:
+
+- **private** (``_live``): owned by exactly one sequence, writable —
+  every block starts here; partially-filled and divergent blocks never
+  leave.
+- **shared** (``_refs``): published under a prefix key, refcounted,
+  immutable by convention (the scheduler only ever writes at positions
+  beyond the resident prefix — copy-on-write happens naturally because
+  the first divergent block is a fresh private block).
+- **cached** (``_cached``): refcount reached 0 but the content is kept
+  resident and addressable, evicted LRU only when ``alloc`` runs short.
+
+``free`` refuses to release a shared or cached block — eviction is the
+only way cached content dies, and a referenced block can never be
+reclaimed (the no-free-while-referenced invariant the property tests
+assert).  With ``prefix_caching=False`` (the default) none of this
+machinery engages and behavior is bit-for-bit the old free-list pool.
 """
 
-__all__ = ["KVBlockPool", "required_blocks"]
+import hashlib
+from collections import OrderedDict
+
+__all__ = ["KVBlockPool", "required_blocks", "key_chain"]
 
 
 def required_blocks(tokens, block_size):
     """Blocks a sequence of ``tokens`` total tokens occupies."""
     return -(-int(tokens) // int(block_size))
+
+
+def key_chain(tokens, block_size):
+    """Rolling content keys of every FULL block of ``tokens``.
+
+    ``keys[i] = sha256(keys[i-1] + tokens_of_block_i)`` — a block's key
+    commits to the entire prefix ending at that block, so two sequences
+    share ``keys[i]`` iff their first ``(i+1) * block_size`` tokens are
+    identical.  Trailing partial blocks get no key (they are still
+    being written)."""
+    bs = int(block_size)
+    toks = [int(t) for t in tokens]
+    keys, parent = [], b"veles-kv"
+    for i in range(len(toks) // bs):
+        h = hashlib.sha256(parent)
+        h.update(b",".join(b"%d" % t for t in toks[i * bs:(i + 1) * bs]))
+        parent = h.digest()
+        keys.append(parent)
+    return keys
 
 
 class KVBlockPool:
@@ -36,9 +82,10 @@ class KVBlockPool:
 
     TRASH = 0           # reserved physical block — never allocated
 
-    def __init__(self, num_blocks, block_size):
+    def __init__(self, num_blocks, block_size, prefix_caching=False):
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        self.prefix_caching = bool(prefix_caching)
         if self.num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is reserved)")
         if self.block_size < 1:
@@ -46,6 +93,16 @@ class KVBlockPool:
         # LIFO: recently-freed blocks are reused first (warm in cache)
         self._free = list(range(self.num_blocks - 1, self.TRASH, -1))
         self._live = set()
+        # prefix-caching domains (empty forever when the flag is off)
+        self._refs = {}                  # block -> refcount (> 0)
+        self._cached = OrderedDict()     # block -> key, LRU order
+        self._key_of = {}                # block -> key (shared + cached)
+        self._by_key = {}                # key -> block
+        # cumulative counters (never reset; surfaced via stats())
+        self.prefix_hits = 0             # admits that reused >= 1 block
+        self.dedup_blocks = 0            # blocks attached already-resident
+        self.published_blocks = 0
+        self.evicted_blocks = 0
 
     @property
     def free_blocks(self):
@@ -53,7 +110,16 @@ class KVBlockPool:
 
     @property
     def live_blocks(self):
-        return len(self._live)
+        """Blocks owned by live sequences (private + shared)."""
+        return len(self._live) + len(self._refs)
+
+    @property
+    def cached_blocks(self):
+        return len(self._cached)
+
+    @property
+    def shared_blocks(self):
+        return len(self._refs)
 
     @property
     def capacity(self):
@@ -66,32 +132,215 @@ class KVBlockPool:
 
     def alloc(self, n):
         """Pop ``n`` blocks, or None (allocation is all-or-nothing —
-        a partial grab would deadlock two half-admitted sequences)."""
+        a partial grab would deadlock two half-admitted sequences).
+
+        Cached (refcount-0) blocks back the free list: when the free
+        list runs short they are evicted oldest-first, so resident
+        prefixes cost nothing until the pool is actually full."""
         n = int(n)
         if n < 1:
             raise ValueError("alloc of %d blocks" % n)
-        if n > len(self._free):
+        if n > len(self._free) + len(self._cached):
             return None
+        while len(self._free) < n:
+            self._evict_one()
         blocks = [self._free.pop() for _ in range(n)]
         self._live.update(blocks)
         return blocks
 
+    def _evict_one(self):
+        block, key = self._cached.popitem(last=False)   # LRU
+        del self._key_of[block]
+        del self._by_key[key]
+        self._free.append(block)
+        self.evicted_blocks += 1
+
     def free(self, blocks):
-        """Return a retired sequence's blocks to the free list."""
+        """Return a retired sequence's PRIVATE blocks to the free list.
+
+        Shared blocks go through :meth:`release` instead — freeing a
+        block some other sequence still reads is the bug class this
+        guard exists for."""
         for b in blocks:
             b = int(b)
             if b == self.TRASH:
                 raise ValueError("block 0 is reserved; it was never "
                                  "allocated")
+            if b in self._refs:
+                raise ValueError("block %d freed while referenced "
+                                 "(refcount %d); use release()"
+                                 % (b, self._refs[b]))
+            if b in self._cached:
+                raise ValueError("block %d is cached prefix content; "
+                                 "only eviction reclaims it" % b)
             if b not in self._live:
                 raise ValueError("double free of block %d" % b)
             self._live.discard(b)
             self._free.append(b)
 
+    # ---------------------------------------------------------------- #
+    # content addressing                                               #
+    # ---------------------------------------------------------------- #
+
+    def _need_prefix(self):
+        if not self.prefix_caching:
+            raise RuntimeError("pool was built with prefix_caching=False")
+
+    def acquire_prefix(self, keys):
+        """Attach to the longest resident chain prefix of ``keys``.
+
+        Returns the matched blocks (possibly empty), each with its
+        refcount incremented — cached blocks are revived to shared.
+        The caller owns exactly one reference per returned block and
+        must :meth:`release` them all at retire."""
+        self._need_prefix()
+        blocks = []
+        for key in keys:
+            b = self._by_key.get(key)
+            if b is None:
+                break
+            if b in self._cached:
+                del self._cached[b]
+                self._refs[b] = 1
+            else:
+                self._refs[b] += 1
+            blocks.append(b)
+        if blocks:
+            self.prefix_hits += 1
+            self.dedup_blocks += len(blocks)
+        return blocks
+
+    def publish(self, block, key):
+        """Move a private block into the shared domain under ``key``.
+
+        Returns False (and leaves the block private) if the key is
+        already resident — the caller keeps its own copy; first writer
+        wins so an existing chain is never rebound under readers."""
+        self._need_prefix()
+        block = int(block)
+        if block not in self._live:
+            raise ValueError("publish of non-private block %d" % block)
+        if key in self._by_key:
+            return False
+        self._live.discard(block)
+        self._refs[block] = 1
+        self._key_of[block] = key
+        self._by_key[key] = block
+        self.published_blocks += 1
+        return True
+
+    def release(self, blocks):
+        """Drop one reference per block; refcount 0 parks the block in
+        the LRU cache (content stays resident and addressable)."""
+        self._need_prefix()
+        for b in blocks:
+            b = int(b)
+            count = self._refs.get(b)
+            if not count:
+                raise ValueError("release of unshared block %d" % b)
+            if count > 1:
+                self._refs[b] = count - 1
+            else:
+                del self._refs[b]
+                self._cached[b] = self._key_of[b]   # newest = last
+
+    def is_shared(self, block):
+        return int(block) in self._refs
+
+    def refcount(self, block):
+        return self._refs.get(int(block), 0)
+
+    # ---------------------------------------------------------------- #
+    # persistence / introspection                                      #
+    # ---------------------------------------------------------------- #
+
+    def state_dict(self):
+        """Picklable index state for checkpoint_kv (keys as hex)."""
+        return {"free": [int(b) for b in self._free],
+                "live": sorted(int(b) for b in self._live),
+                "refs": {str(b): int(c) for b, c in self._refs.items()},
+                "cached": [[int(b), k.hex()]
+                           for b, k in self._cached.items()],
+                "keys": {str(b): k.hex()
+                         for b, k in self._key_of.items()}}
+
+    def load_state(self, state):
+        self._free = [int(b) for b in state["free"]]
+        self._live = set(int(b) for b in state["live"])
+        self._refs = {int(b): int(c)
+                      for b, c in state.get("refs", {}).items()}
+        self._cached = OrderedDict(
+            (int(b), bytes.fromhex(k))
+            for b, k in state.get("cached", []))
+        self._key_of = {int(b): bytes.fromhex(k)
+                        for b, k in state.get("keys", {}).items()}
+        self._by_key = {k: b for b, k in self._key_of.items()}
+        violations = self.check_integrity()
+        if violations:
+            raise ValueError("corrupt pool state: %s" % "; ".join(violations))
+
+    def check_integrity(self):
+        """List of invariant violations (empty == healthy pool)."""
+        bad = []
+        domains = [set(self._free), self._live,
+                   set(self._refs), set(self._cached)]
+        total = sum(len(d) for d in domains)
+        if total != self.capacity:
+            bad.append("free+live+shared+cached=%d != capacity=%d"
+                       % (total, self.capacity))
+        seen = set()
+        for d in domains:
+            if seen & d:
+                bad.append("block(s) %s in two domains"
+                           % sorted(seen & d))
+            seen |= d
+        if self.TRASH in seen:
+            bad.append("trash block allocated")
+        keyed = set(self._refs) | set(self._cached)
+        if set(self._key_of) != keyed:
+            bad.append("key index out of sync with shared+cached")
+        if len(self._by_key) != len(self._key_of):
+            bad.append("duplicate keys in block index")
+        if any(c < 1 for c in self._refs.values()):
+            bad.append("non-positive refcount")
+        return bad
+
+    def dump(self):
+        """Introspection snapshot for tools/kv_inspect.py."""
+        alloc_total = self.published_blocks + self.dedup_blocks
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "prefix_caching": self.prefix_caching,
+            "free_blocks": self.free_blocks,
+            "private_blocks": len(self._live),
+            "shared": sorted(
+                ({"block": b, "key": self._key_of[b].hex()[:12],
+                  "refcount": c} for b, c in self._refs.items()),
+                key=lambda e: e["block"]),
+            "cached": [{"block": b, "key": k.hex()[:12]}
+                       for b, k in self._cached.items()],
+            "prefix_hits": self.prefix_hits,
+            "dedup_blocks": self.dedup_blocks,
+            "published_blocks": self.published_blocks,
+            "evicted_blocks": self.evicted_blocks,
+            "dedup_ratio": round(self.dedup_blocks / alloc_total, 4)
+                           if alloc_total else 0.0,
+            "integrity": self.check_integrity(),
+        }
+
     def stats(self):
-        return {"num_blocks": self.num_blocks,
-                "block_size": self.block_size,
-                "free_blocks": self.free_blocks,
-                "live_blocks": self.live_blocks,
-                "utilization": round(
-                    self.live_blocks / max(self.capacity, 1), 4)}
+        out = {"num_blocks": self.num_blocks,
+               "block_size": self.block_size,
+               "free_blocks": self.free_blocks,
+               "live_blocks": self.live_blocks,
+               "utilization": round(
+                   self.live_blocks / max(self.capacity, 1), 4)}
+        if self.prefix_caching:
+            out.update(shared_blocks=self.shared_blocks,
+                       cached_blocks=self.cached_blocks,
+                       prefix_hits=self.prefix_hits,
+                       dedup_blocks=self.dedup_blocks,
+                       published_blocks=self.published_blocks,
+                       evicted_blocks=self.evicted_blocks)
+        return out

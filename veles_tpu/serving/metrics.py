@@ -277,7 +277,29 @@ _DECODE_COUNTERS = {
                      "Live sessions exported to a peer or spilled"),
     "migrated_in": ("veles_serving_decode_migrated_in_total",
                     "Live sessions imported mid-generation"),
+    "prefix_hits": ("veles_serving_kv_prefix_hits_total",
+                    "Admits that attached to >= 1 already-resident "
+                    "KV block (prefix cache hit)"),
+    "dedup_blocks": ("veles_serving_kv_blocks_dedup",
+                     "KV blocks attached already-resident at admit "
+                     "instead of re-prefilled (cumulative)"),
+    "chunks": ("veles_serving_prefill_chunks_total",
+               "Prefill chunk executions (the one-executable chunked "
+               "path interleaved with decode steps)"),
 }
+
+#: resident-prefix fraction bands of the split TTFT histogram: how much
+#: of the prompt was already cached when the sequence was admitted
+_PREFIX_BANDS = ((0.5, "major"), (0.0, "minor"))
+
+
+def _prefix_band(resident):
+    if not resident:
+        return "none"
+    for floor, band in _PREFIX_BANDS:
+        if resident >= floor:
+            return band
+    return "none"
 
 
 class DecodeMetrics:
@@ -310,6 +332,17 @@ class DecodeMetrics:
             "veles_serving_decode_ttft_seconds",
             "Submit-to-first-token latency (queue + prefill)",
             ("model",)).labels(model=model)
+        # TTFT split by how much of the prompt was already resident at
+        # admit — the per-band family the prefix-reuse win shows up in
+        # (bands: none / minor (< 50%) / major (>= 50%))
+        self._h_ttft_prefix = self.registry.histogram(
+            "veles_serving_decode_ttft_by_prefix_seconds",
+            "Submit-to-first-token latency split by resident-prefix "
+            "fraction at admit", ("model", "resident"))
+        self._g_chunk_queue = self.registry.gauge(
+            "veles_serving_prefill_chunk_queue",
+            "Sequences currently mid-chunked-prefill",
+            ("model",)).labels(model=model)
         self._g_active = self.registry.gauge(
             "veles_serving_decode_active_rows",
             "Sequences currently decoding", ("model",)).labels(
@@ -334,14 +367,36 @@ class DecodeMetrics:
         raise AttributeError(name)
 
     # -- recording (scheduler worker thread) ---------------------------------
-    def record_admit(self, prompt_tokens):
+    def record_admit(self, prompt_tokens, prefilled=None):
+        """``prefilled``: prompt tokens the prefill actually has to
+        process (prompt minus the resident prefix); defaults to the
+        whole prompt."""
         self._c["sequences"].inc()
-        self._c["prefill_tokens"].inc(int(prompt_tokens))
+        self._c["prefill_tokens"].inc(int(
+            prompt_tokens if prefilled is None else prefilled))
 
-    def record_first_token(self, seconds):
-        """TTFT for one sequence: submit -> prefill's first token."""
+    def record_prefix(self, matched_blocks):
+        """One admission's prefix-reuse outcome: 0 matched blocks is a
+        miss, anything else a hit of that many dedup'd blocks."""
+        if matched_blocks:
+            self._c["prefix_hits"].inc()
+            self._c["dedup_blocks"].inc(int(matched_blocks))
+
+    def record_chunk(self):
+        self._c["chunks"].inc()
+
+    def set_chunk_queue(self, depth):
+        self._g_chunk_queue.set(int(depth))
+
+    def record_first_token(self, seconds, resident=None):
+        """TTFT for one sequence: submit -> prefill's first token.
+        ``resident``: fraction of the prompt already cached at admit
+        (None/0 when prefix caching is off or nothing matched)."""
         self.ttft.record(seconds)
         self._h_ttft.observe(seconds)
+        self._h_ttft_prefix.labels(
+            model=self.model,
+            resident=_prefix_band(resident)).observe(seconds)
         self._c["tokens"].inc()
         with self._lock:
             self._emissions.append((time.time(), 1))

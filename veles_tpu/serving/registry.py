@@ -154,7 +154,8 @@ class ModelRegistry:
                   if k in ("max_batch", "block_size", "max_prompt_len",
                            "max_new_tokens", "num_blocks",
                            "queue_limit", "cache", "manifest",
-                           "warmup")}
+                           "warmup", "prefix_caching",
+                           "prefill_chunk_tokens")}
         # a model may carry its own geometry (the toydecode spec path):
         # registry-wide defaults < model defaults < explicit kwargs
         kwargs.update(getattr(model, "decode_defaults", None) or {})

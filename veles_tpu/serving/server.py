@@ -111,6 +111,19 @@ class _ServingHandler(JsonRequestHandler):
             self.send_json(200, srv.registry.metrics_snapshot())
         elif path == "/models":
             self.send_json(200, srv.registry.describe())
+        elif path.startswith("/api/") and path.endswith("/kv"):
+            # live KV pool introspection (tools/kv_inspect.py): resident
+            # prefixes, refcounts, dedupe ratio, integrity verdict
+            name = path[len("/api/"):-len("/kv")] or None
+            entry = srv.registry.get(name)
+            if entry is None or not hasattr(entry.scheduler, "kv_dump"):
+                self.send_json(404, {"error": "no decode model %r"
+                                     % name})
+                return
+            try:
+                self.send_json(200, entry.scheduler.kv_dump())
+            except Exception as exc:  # noqa: BLE001 — draining et al.
+                self.send_json(503, {"error": str(exc)})
         elif path == "/admin/sessions" and srv.enable_admin:
             out = {}
             for name in srv.registry.names():

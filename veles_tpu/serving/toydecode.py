@@ -49,12 +49,16 @@ class ToyDecodeModel:
 
     kind = "decode"
 
-    def __init__(self, vocab=97, step_delay=0.0, decode_defaults=None):
+    def __init__(self, vocab=97, step_delay=0.0, prefill_delay=0.0,
+                 decode_defaults=None):
         self.vocab = int(vocab)
         if self.vocab < 2:
             raise ValueError("vocab must be >= 2")
         # honored by DecodeScheduler._step: host sleep per step
         self.step_host_delay = float(step_delay)
+        # honored by the prefill paths: host sleep per PROMPT TOKEN
+        # actually processed (chunks pay only their own tokens)
+        self.prefill_host_delay = float(prefill_delay)
         # geometry the registry applies when serving this model
         # (registry defaults < these < explicit kwargs)
         self.decode_defaults = dict(decode_defaults or {})
@@ -88,6 +92,38 @@ class ToyDecodeModel:
             return first.astype(jnp.int32), (k,), (v,)
 
         return prefill
+
+    def prefill_chunk_fn(self, block_size):
+        import jax.numpy as jnp
+        bs = int(block_size)
+        vocab = self.vocab
+
+        def chunk(tokens, start, length, k_pools, v_pools, block_row):
+            k, v = k_pools[0], v_pools[0]
+            c = tokens.shape[0]
+            pos = start + jnp.arange(c, dtype=jnp.int32)
+            valid = pos < length
+            dest = jnp.where(valid, block_row[pos // bs], 0)
+            off = pos % bs
+            k = k.at[dest, off].set(jnp.where(valid, tokens, 0))
+            v = v.at[dest, off].set(jnp.where(valid, 3 * tokens + 1, 0))
+            # the sums run over the WHOLE cached prompt gathered
+            # through the block row — the resident prefix is READ, not
+            # recomputed, so a mutated or mis-matched shared block
+            # changes the first token (the COW fingerprint the prefix
+            # tests rely on)
+            flat_k = k[block_row].reshape(-1)
+            flat_v = v[block_row].reshape(-1)
+            gpos = jnp.arange(flat_k.shape[0], dtype=jnp.int32)
+            mask = gpos < length
+            s1 = jnp.sum(jnp.where(mask, flat_k, 0))
+            s2 = jnp.sum(jnp.where(mask, flat_v, 0))
+            last = tokens[jnp.clip(length - 1 - start, 0, c - 1)]
+            first = (s1 * _A + s2 * _B + last * _C
+                     + length * _D) % vocab
+            return first.astype(jnp.int32), (k,), (v,)
+
+        return chunk
 
     def decode_fn(self, block_size):
         import jax.numpy as jnp
@@ -139,7 +175,8 @@ class ToyDecodeModel:
 #: spec keys → DecodeScheduler geometry kwargs
 _GEOM_KEYS = {"max_batch": "max_batch", "block": "block_size",
               "max_prompt": "max_prompt_len", "max_new": "max_new_tokens",
-              "num_blocks": "num_blocks", "queue_limit": "queue_limit"}
+              "num_blocks": "num_blocks", "queue_limit": "queue_limit",
+              "chunk": "prefill_chunk_tokens", "prefix": "prefix_caching"}
 
 
 def from_spec(spec):
@@ -147,7 +184,7 @@ def from_spec(spec):
     its scheduler geometry in ``decode_defaults`` (vocab/delay are
     model knobs; the rest are geometry)."""
     body = spec.partition(":")[2]
-    vocab, delay, defaults = 97, 0.0, {}
+    vocab, delay, pdelay, defaults = 97, 0.0, 0.0, {}
     for part in filter(None, body.split(",")):
         key, _, value = part.partition("=")
         key = key.strip()
@@ -155,11 +192,14 @@ def from_spec(spec):
             vocab = int(value)
         elif key == "delay":
             delay = float(value)
+        elif key == "pdelay":
+            pdelay = float(value)
         elif key in _GEOM_KEYS:
             defaults[_GEOM_KEYS[key]] = int(value)
         else:
             raise ValueError("unknown toydecode spec key %r (want "
-                             "vocab, delay, %s)"
+                             "vocab, delay, pdelay, %s)"
                              % (key, ", ".join(sorted(_GEOM_KEYS))))
     return ToyDecodeModel(vocab=vocab, step_delay=delay,
+                          prefill_delay=pdelay,
                           decode_defaults=defaults)

@@ -45,7 +45,8 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["DEFAULT_BLOCK_SIZE", "paged_attention",
-           "paged_attention_reference", "required_blocks"]
+           "paged_attention_reference", "paged_prefill_attention",
+           "paged_prefill_attention_reference", "required_blocks"]
 
 _NEG_INF = float("-inf")
 
@@ -179,6 +180,52 @@ def paged_attention(q, k_pool, v_pool, page_table, lengths, scale=None):
         interpret=_interpret(),
     )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
       q, k_pool, v_pool)
+
+
+def _prefill_table_lengths(block_row, start, length, chunk):
+    """One sequence's chunk as a ragged "batch": every chunk token
+    shares the sequence's block row, and causal masking IS the ragged
+    length masking — query at absolute position ``p`` attends to
+    ``p + 1`` cached tokens.  Positions past ``length`` are padding
+    rows (length 0 → zeros, the kernel's existing convention)."""
+    table = jnp.broadcast_to(block_row.astype(jnp.int32)[None, :],
+                             (chunk, block_row.shape[0]))
+    pos = start + jnp.arange(chunk, dtype=jnp.int32)
+    lens = jnp.where(pos < length, pos + 1, 0).astype(jnp.int32)
+    return table, lens
+
+
+def paged_prefill_attention(q, k_pool, v_pool, block_row, start, length,
+                            scale=None):
+    """Chunked-prefill attention over a partially-resident page table.
+
+    ``q``: [C, H, D] — one fixed-size chunk of prompt queries for ONE
+    sequence, absolute positions ``start .. start + C - 1``;
+    ``block_row``: int32 [max_blocks] — the sequence's page-table row
+    (resident prefix blocks + freshly written chunk blocks, 0-padded);
+    ``start``/``length``: scalars — chunk origin and total prompt
+    length (positions past ``length`` are padding and return zeros).
+
+    No new kernel: the chunk is dispatched through the decode kernel
+    with the chunk axis as the batch axis and per-query causal lengths
+    ``start + i + 1`` — which is exactly why ragged paged attention
+    (arXiv 2604.15464) serves mixed prefill/decode from ONE executable.
+    The resident prefix is read straight from the pool, so a prompt
+    whose first blocks are already cached prefills only its suffix.
+    """
+    table, lens = _prefill_table_lengths(block_row, start, length,
+                                         q.shape[0])
+    return paged_attention(q, k_pool, v_pool, table, lens, scale=scale)
+
+
+def paged_prefill_attention_reference(q, k_pool, v_pool, block_row,
+                                      start, length, scale=None):
+    """Dense oracle for :func:`paged_prefill_attention` (same staging
+    as :func:`paged_attention_reference`, so parity stays bitwise)."""
+    table, lens = _prefill_table_lengths(block_row, start, length,
+                                         q.shape[0])
+    return paged_attention_reference(q, k_pool, v_pool, table, lens,
+                                     scale=scale)
 
 
 def paged_attention_reference(q, k_pool, v_pool, page_table, lengths,

@@ -294,6 +294,55 @@ def prefill(params, tokens, length, k_pools, v_pools, block_row, *,
     return token, tuple(new_k), tuple(new_v)
 
 
+def prefill_chunk(params, tokens, start, length, k_pools, v_pools,
+                  block_row, *, heads=2, block_size=8, k=1):
+    """One fixed-size prefill chunk: positions ``start .. start+C-1``
+    of a prompt whose earlier K/V — resident prefix blocks reused from
+    the pool plus chunks already executed — are read back THROUGH the
+    page-table row, not recomputed.  Per-layer: write this chunk's K/V
+    into its pool slots, then ragged paged attention with per-query
+    causal lengths (znicz.paged_attention.paged_prefill_attention).
+
+    Static shapes: [C] tokens, scalar start/length — ONE executable
+    covers every chunk of every prompt, which is what lets the
+    scheduler interleave prefill chunks with decode steps instead of
+    stalling the batch on a monolithic ladder call.  Returns (token,
+    pools); the token is the first generated token and is only
+    meaningful on the final chunk (``start + C >= length``).
+    """
+    from ..paged_attention import paged_prefill_attention
+    c = int(tokens.shape[0])
+    h = params["emb"][tokens][None]              # [1, C, d]
+    stacked = _stacked(params)
+    stages = stacked["qkv"].shape[0]
+    d = h.shape[-1]
+    hd = d // heads
+    pos = start + jnp.arange(c)
+    valid = pos < length
+    # invalid positions scatter into the reserved trash block
+    blk = jnp.where(valid, block_row[pos // block_size], 0)
+    off = pos % block_size
+    k_pools, v_pools = list(k_pools), list(v_pools)
+    for i in range(stages):
+        p_i = jax.tree.map(lambda p: p[i], stacked)
+        qkv = _rmsnorm(h) @ p_i["qkv"]           # [1, C, 3d]
+        q, kk, vv = (qkv[..., j * d:(j + 1) * d].reshape(1, c, heads,
+                                                         hd)
+                     for j in range(3))
+        k_pools[i] = k_pools[i].at[blk, off].set(kk[0])
+        v_pools[i] = v_pools[i].at[blk, off].set(vv[0])
+        a = paged_prefill_attention(q[0], k_pools[i], v_pools[i],
+                                    block_row, start, length,
+                                    scale=1.0 / math.sqrt(hd))
+        h = h + a.reshape(1, c, d) @ p_i["proj"]
+        moe = _moe_dense(p_i, _rmsnorm(h).reshape(c, d), k)
+        h = h + moe.reshape(1, c, d)
+    last = jnp.clip(length - 1 - start, 0, c - 1)
+    logits = h[0, last] @ params["emb"].T
+    return (jnp.argmax(logits).astype(jnp.int32), tuple(k_pools),
+            tuple(v_pools))
+
+
 def _decode_block(p_i, h, k_pool_i, v_pool_i, page_table, lengths,
                   blk, off, heads, k):
     """One single-token block: write this token's K/V into its pool
@@ -408,6 +457,18 @@ class FlagshipDecodeModel:
             return prefill(params, tokens, length, k_pools, v_pools,
                            block_row, heads=heads,
                            block_size=block_size, k=k)
+        return fn
+
+    def prefill_chunk_fn(self, block_size):
+        """(tokens[C], start, length, k_pools, v_pools, block_row) ->
+        (token, pools) — the one-executable chunked-prefill step."""
+        params, heads, k = self.params, self.heads, self.k
+
+        def fn(tokens, start, length, k_pools, v_pools, block_row):
+            return prefill_chunk(params, tokens, start, length,
+                                 k_pools, v_pools, block_row,
+                                 heads=heads, block_size=block_size,
+                                 k=k)
         return fn
 
     def decode_fn(self, block_size):
