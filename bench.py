@@ -854,6 +854,68 @@ def bench_prefix_reuse(probe_timeout=300):
     return out
 
 
+def bench_speculative(probe_timeout=300):
+    """Speculative decoding: draft-and-verify through the multi-token
+    verify entry of the paged-attention path (ISSUE 15 acceptance:
+    every emitted sequence bitwise-equal to the plain-decode oracle,
+    tok/s beating the plain scheduler above the measured acceptance
+    threshold, zero steady-state recompiles across a warm restart
+    including the @draft/@verify executables).  Cold/warm probe pair
+    like the decode stage: two fresh subprocesses sharing one cache
+    dir, the second IS the restart; a third probe at a low drafter
+    agreement rate records the other side of the acceptance crossover
+    (where rejected drafts stop paying for the verify width)."""
+    import subprocess
+    import tempfile
+    _stamp("speculative stage")
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "serve_bench.py")
+    cache_dir = os.path.join(
+        tempfile.mkdtemp(prefix="veles-spec-bench-"), "compile_cache")
+
+    def probe(tag, agree):
+        argv = [sys.executable, tool, "--spec-depth", "1,2,3,4",
+                "--spec-agree", str(agree), "--json",
+                "--cache-dir", cache_dir]
+        proc = subprocess.run(argv, capture_output=True,
+                              timeout=probe_timeout)
+        line = _last_json_line(proc.stdout.decode())
+        if line is None:
+            raise RuntimeError("spec probe (%s) failed: %s"
+                               % (tag, proc.stderr.decode()[-400:]))
+        _stamp("spec %s (agree %s): best depth %s = %sx vs plain, "
+               "match=%s, %s post-warmup compiles"
+               % (tag, agree, line.get("spec_best_depth"),
+                  line.get("spec_best_speedup"),
+                  line.get("spec_tokens_match"),
+                  line.get("spec_post_warmup_compiles")))
+        return line
+
+    cold = probe("cold", 0.9)
+    warm = probe("warm", 0.9)   # the restart: manifest + cache replay
+    low = probe("low_agree", 0.3)
+    keys = ("spec_plain_tok_s", "spec_best_depth", "spec_best_tok_s",
+            "spec_best_speedup", "spec_tokens_match",
+            "spec_token_mismatches", "spec_post_warmup_compiles")
+    out = {k: warm.get(k) for k in keys}
+    for d in warm.get("spec_depths") or []:
+        for k in ("spec_tok_s_depth%d" % d,
+                  "spec_acceptance_depth%d" % d):
+            out[k] = warm.get(k)
+    out["spec_cold_best_speedup"] = cold.get("spec_best_speedup")
+    out["spec_low_agree_speedup"] = low.get("spec_best_speedup")
+    out["spec_low_agree_tokens_match"] = low.get("spec_tokens_match")
+    # the acceptance crossover: high agreement must beat plain, and the
+    # low-agreement sweep must land strictly below the high one
+    out["spec_crossover_observed"] = bool(
+        (warm.get("spec_best_speedup") or 0) > 1.0
+        and (low.get("spec_best_speedup") or 1e9)
+        < (warm.get("spec_best_speedup") or 0))
+    out["spec_config"] = _autotune_provenance(
+        "serving.spec_depth", {"max_new_tokens": 16})
+    return out
+
+
 def bench_fleet(replicas=3, probe_timeout=360):
     """Multi-replica serving fleet (ISSUE 7 acceptance: >= 0.8
     replica-scaling efficiency on the open-loop serve_bench load, a
@@ -1464,6 +1526,8 @@ def _stage_main(stage):
         out = bench_decode()
     elif stage == "prefix_reuse":
         out = bench_prefix_reuse()
+    elif stage == "speculative":
+        out = bench_speculative()
     elif stage == "fleet":
         out = bench_fleet()
     elif stage == "chaos":
@@ -1537,6 +1601,13 @@ STAGE_PLAN = [
     # dedupe across shared-system-prompt sequences with oracle-bitwise
     # tokens, warm restart compiles == 0 including the chunk executable
     ("prefix_reuse", 300),
+    # speculative decoding (ISSUE 15): plain vs draft-and-verify tok/s
+    # at each depth with a tunable drafter agreement rate — bitwise
+    # oracle tokens, the acceptance crossover (high agreement wins,
+    # low agreement loses), warm restart compiles == 0 including the
+    # @draft/@verify executables; three fresh subprocesses over one
+    # cache dir
+    ("speculative", 360),
     # multi-replica serving fleet: scaling efficiency, SIGKILL
     # kill-recovery (zero non-429 failures, warm compiles==0 respawn)
     # and rolling-update error rate (ISSUE 7) — one fresh subprocess

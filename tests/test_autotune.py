@@ -69,7 +69,8 @@ def test_every_site_default_is_its_own_first_candidate():
                                 "length": 48},
             "serving.bucket_ladder": {"max_batch": 16},
             "serving.decode": {"max_context": 64},
-            "serving.prefill_chunk": {"max_prompt_len": 64}}
+            "serving.prefill_chunk": {"max_prompt_len": 64},
+            "serving.spec_depth": {"max_new_tokens": 32}}
     assert set(ctxs) == set(space.SITES)
     for name, ctx in ctxs.items():
         sp = space.site(name)
@@ -102,6 +103,8 @@ def test_space_defaults_match_kernel_constants():
     from veles_tpu.serving import decode
     assert space.site("serving.prefill_chunk").default == {
         "chunk_tokens": decode.DEFAULT_PREFILL_CHUNK}
+    assert space.site("serving.spec_depth").default == {
+        "spec_depth": decode.DEFAULT_SPEC_DEPTH}
 
 
 def test_ladder_pow2_is_byte_identical_to_bucket_sizes():

@@ -293,14 +293,29 @@ def test_sigstop_hung_replica_fails_over_and_readmits():
     victim = "r0"
     pid = fleet.supervisor._replicas[victim].pid
     try:
+        # start() returns on the FIRST ready replica; if the poll has
+        # not yet marked the victim ready, freezing it now means pick()
+        # never offers it and the breaker has nothing to trip on — the
+        # victim must be carrying traffic before the freeze
+        _wait(lambda: router.ready_count() == 2, timeout=30,
+              what="both replicas ready before the freeze")
         router.set_admitting("r1", False)      # pin dispatch to victim
         os.kill(pid, signal.SIGSTOP)
         results = []
 
         def fire():
-            results.append(_post(fleet.url + "/api/m",
-                                 {"input": [[1, 2, 3, 4]]},
-                                 timeout=30)[0])
+            # the poll can mark the victim down while r1 is still
+            # non-admitting: that window answers 503 (no target), and
+            # a well-behaved client retries through it
+            status = -1
+            for _ in range(20):
+                status = _post(fleet.url + "/api/m",
+                               {"input": [[1, 2, 3, 4]]},
+                               timeout=30)[0]
+                if status != 503:
+                    break
+                time.sleep(0.1)
+            results.append(status)
         t0 = time.perf_counter()
         threads = [threading.Thread(target=fire) for _ in range(3)]
         for t in threads:
@@ -308,20 +323,20 @@ def test_sigstop_hung_replica_fails_over_and_readmits():
         time.sleep(0.3)                        # in flight, frozen
         router.set_admitting("r1", True)       # failover destination
         _wait(lambda: not router.replica(victim).up,
-              timeout=3.0, what="poll to mark hung replica down")
+              timeout=8.0, what="poll to mark hung replica down")
         for t in threads:
             t.join(30)
         elapsed = time.perf_counter() - t0
         assert results == [200] * 3, results
-        # bounded by request_timeout + retry, not a 60 s default
-        assert elapsed < 10, elapsed
+        # bounded by request_timeout + retries, not a 60 s default
+        assert elapsed < 20, elapsed
         # three concurrent timeouts = three consecutive connection
         # failures: the breaker tripped
         assert router.replica(victim).breaker == "open"
         os.kill(pid, signal.SIGCONT)
         _wait(lambda: (router.replica(victim).up
                        and router.replica(victim).breaker == "closed"),
-              timeout=10.0, what="SIGCONT re-admission via half-open")
+              timeout=20.0, what="SIGCONT re-admission via half-open")
         assert fleet.supervisor.describe()[victim]["restarts"] == 0
     finally:
         try:

@@ -1,0 +1,438 @@
+"""Speculative decoding: draft-and-verify through the ragged
+paged-attention kernel (ISSUE 15).
+
+The contract under test (acceptance):
+- with the knob OFF (the default) behavior is bit-for-bit the prior
+  scheduler: per-token decode steps, no draft/verify executables, no
+  speculation keys in stats — MIGRATION.md's "default-off" note is
+  test-enforced here;
+- the multi-token verify entry (``paged_verify_attention``) is bitwise
+  equal to its dense reference — same staging, same contract as the
+  single-token kernel;
+- every emitted sequence is bitwise equal to the plain-decode oracle at
+  EVERY depth and EVERY drafter agreement rate (greedy rejection
+  sampling: accept the longest matching draft prefix plus the target's
+  own correction token) — on the toy recurrence AND the real
+  transformer;
+- rejected positions roll back: length never advances over them, the
+  pool partition survives speculation + prefix-caching churn, and
+  published history can never contain rejected content;
+- speculation composes with chunked prefill, prefix reuse, live
+  migration and checkpoint/restore (a checkpoint crosses the spec
+  on/off boundary — the strategy is not geometry);
+- a warm restart through the compile cache + manifest compiles NOTHING
+  — ``@draft``/``@verify`` are two more manifest entries, not
+  recompiles;
+- the metrics surface reports draft/accept/reject counters and the
+  windowed acceptance rate, and ``GET /api/<model>/kv`` carries the
+  speculation block tools/kv_inspect.py renders.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.serving import DecodeScheduler, ToyDecodeModel
+from veles_tpu.serving.sessions import pack_states, unpack_states
+from veles_tpu.znicz.paged_attention import (
+    paged_verify_attention, paged_verify_attention_reference,
+    required_blocks)
+from veles_tpu.znicz.samples.flagship import (FlagshipDecodeModel,
+                                              generate_reference)
+
+GEOM = dict(max_batch=3, block_size=4, max_prompt_len=16,
+            max_new_tokens=8)
+
+
+@pytest.fixture(scope="module")
+def toy():
+    return ToyDecodeModel(vocab=31, draft_agreement=0.75)
+
+
+@pytest.fixture(scope="module")
+def toy_oracle(toy):
+    memo = {}
+
+    def run(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in memo:
+            memo[key] = toy.generate_reference(prompt, n)
+        return memo[key]
+    return run
+
+
+def _requests(rng, n, vocab=31, max_prompt=16, max_new=8):
+    return [(rng.randint(0, vocab, rng.randint(1, max_prompt + 1))
+             .tolist(), int(rng.randint(1, max_new + 1)))
+            for _ in range(n)]
+
+
+# -- verify kernel entry ------------------------------------------------------
+
+def test_paged_verify_attention_bitwise_vs_reference():
+    """The q_len>1 verify entry routes through the SAME kernel as the
+    single-token step (span flattened into the batch axis, per-query
+    causal lengths) — its contract with the dense reference is bitwise,
+    including padding rows and spans crossing block boundaries."""
+    rng = numpy.random.RandomState(0)
+    b, s, heads, d, bs = 3, 3, 2, 16, 4
+    length = 9                                 # span straddles a block
+    max_blocks = required_blocks(length + s, bs)
+    num_blocks = b * max_blocks + 1
+    k_pool, v_pool = (jnp.asarray(
+        rng.standard_normal((num_blocks, bs, heads, d)) * 0.5,
+        jnp.float32) for _ in range(2))
+    table = numpy.zeros((b, max_blocks), numpy.int32)
+    lengths = numpy.asarray([length, 2, 0], numpy.int32)  # row 2 padded
+    blk = 1
+    for i in range(b):
+        if lengths[i] == 0:
+            continue
+        for j in range(required_blocks(int(lengths[i]) + s, bs)):
+            table[i, j] = blk
+            blk += 1
+    q = jnp.asarray(rng.standard_normal((b, s, heads, d)) * 0.5,
+                    jnp.float32)
+    args = (q, k_pool, v_pool, jnp.asarray(table),
+            jnp.asarray(lengths))
+    out = numpy.asarray(jax.jit(paged_verify_attention)(*args))
+    want = numpy.asarray(
+        jax.jit(paged_verify_attention_reference)(*args))
+    assert out.shape == (b, s, heads, d)
+    assert numpy.array_equal(out, want)        # BITWISE, not allclose
+    # a padding row contributes nothing but must not be NaN
+    assert numpy.all(numpy.isfinite(out))
+
+
+# -- default off == prior scheduler -------------------------------------------
+
+def test_spec_default_off_is_prior_behavior(toy):
+    s = DecodeScheduler(toy, name="specoff", **GEOM)
+    try:
+        stats = s.stats()
+        assert stats["executables"] == 1 + len(stats["buckets"])
+        for key in ("spec_depth", "spec_source", "draft_tokens",
+                    "accepted_tokens", "rejected_tokens",
+                    "acceptance_rate", "rolled_back_tokens"):
+            assert key not in stats
+        assert "speculation" not in s.kv_dump()
+    finally:
+        s.close(drain=True)
+    with pytest.raises(ValueError, match="spec_depth"):
+        DecodeScheduler(toy, name="specbad", **GEOM, spec_depth=0,
+                        warmup=False)
+
+
+def test_spec_requires_model_support(toy):
+    class NoDraft:
+        """A decode adapter without the drafter closure pair."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.vocab = inner.vocab
+
+        def __getattr__(self, name):
+            if name in ("draft_fn", "verify_fn"):
+                raise AttributeError(name)
+            return getattr(self._inner, name)
+
+    with pytest.raises(ValueError, match="draft_fn"):
+        DecodeScheduler(NoDraft(toy), name="nodraft", **GEOM,
+                        spec_depth=2, warmup=False)
+
+
+def test_spec_on_off_byte_equivalence(toy, toy_oracle):
+    """The same request mix through a plain and a speculative scheduler
+    produces identical token streams — and only the speculative one
+    grows the stats surface."""
+    rng = numpy.random.RandomState(4)
+    requests = _requests(rng, 10)
+    outs = {}
+    for depth in (None, 3):
+        s = DecodeScheduler(toy, name="eqv%s" % (depth or 0), **GEOM,
+                            spec_depth=depth)
+        try:
+            futures = [s.submit(p, n) for p, n in requests]
+            outs[depth] = [f.result(60)["tokens"] for f in futures]
+            stats = s.stats()
+            if depth:
+                assert stats["spec_depth"] == depth
+                assert stats["spec_source"] == "explicit"
+                assert stats["draft_tokens"] > 0
+                assert stats["executables"] == \
+                    3 + len(stats["buckets"])  # + draft + verify
+            else:
+                assert "spec_depth" not in stats
+        finally:
+            s.close(drain=True)
+    assert outs[None] == outs[3]
+    for (p, n), got in zip(requests, outs[3]):
+        assert got == toy_oracle(p, n)
+
+
+# -- oracle bitwise at every depth / agreement --------------------------------
+
+@pytest.mark.parametrize("depth", (1, 2, 4))
+@pytest.mark.parametrize("agreement", (1.0, 0.6, 0.0))
+def test_spec_matches_oracle_toy(depth, agreement):
+    """Greedy rejection sampling is EXACT regardless of drafter
+    quality: agreement 1.0 accepts everything, 0.0 rejects every draft
+    (pure verify-correction decode) — the emitted stream never moves."""
+    model = ToyDecodeModel(vocab=31, draft_agreement=agreement)
+    rng = numpy.random.RandomState(depth)
+    requests = _requests(rng, 8)
+    s = DecodeScheduler(model, name="ora%d_%d" % (depth,
+                                                  int(agreement * 10)),
+                        **GEOM, spec_depth=depth)
+    try:
+        futures = [s.submit(p, n) for p, n in requests]
+        for (p, n), f in zip(requests, futures):
+            assert f.result(60)["tokens"] == \
+                model.generate_reference(p, n)
+        stats = s.stats()
+        if agreement == 1.0:
+            assert stats["rejected_tokens"] == 0
+        if agreement == 0.0 and depth > 1:
+            # corrupted drafts: at most the first position can agree by
+            # coincidence never, so acceptance collapses
+            assert stats["acceptance_rate"] == 0.0
+    finally:
+        s.close(drain=True)
+
+
+def test_spec_matches_oracle_flagship():
+    """Same contract on the real transformer: the unigram drafter's
+    proposals run the float verify path (multi-token attention, MoE,
+    argmax) and the output equals the cache-free reference exactly."""
+    model = FlagshipDecodeModel(stages=2, experts=2, d=16, heads=2,
+                                hidden=32, vocab=32, seed=0)
+    rng = numpy.random.RandomState(2)
+    requests = [(rng.randint(0, 32, rng.randint(1, 9)).tolist(), 6)
+                for _ in range(6)]
+    s = DecodeScheduler(model, name="oraflag", max_batch=3,
+                        block_size=4, max_prompt_len=8,
+                        max_new_tokens=6, spec_depth=2)
+    try:
+        futures = [s.submit(p, n) for p, n in requests]
+        for (p, n), f in zip(requests, futures):
+            assert f.result(120)["tokens"] == \
+                generate_reference(model.params, p, n)
+        assert s.stats()["post_warmup_compiles"] == 0
+    finally:
+        s.close(drain=True)
+
+
+# -- rollback + composition with prefix caching / chunking --------------------
+
+def test_spec_rollback_pool_invariants_under_churn(toy_oracle):
+    """A heavy-rejection drafter over a prefix-caching pool: every
+    verify pass writes k+1 positions and most roll back — the pool
+    partition must survive, published blocks must only ever cover TRUE
+    history (every follower's tokens stay bitwise), and the rollback
+    tallies must surface in the dump."""
+    model = ToyDecodeModel(vocab=31, draft_agreement=0.2)
+    s = DecodeScheduler(model, name="rollback", max_batch=3,
+                        block_size=4, max_prompt_len=12,
+                        max_new_tokens=8, num_blocks=14,
+                        prefix_caching=True, prefill_chunk_tokens=4,
+                        spec_depth=3)
+    try:
+        rng = numpy.random.RandomState(6)
+        systems = [[1, 2, 3, 4], [9, 8, 7, 6, 5, 4, 3, 2]]
+        requests = []
+        for _ in range(18):
+            base = systems[rng.randint(2)] if rng.rand() < 0.7 else []
+            tail = rng.randint(0, 31, rng.randint(1, 5)).tolist()
+            requests.append((base + tail, int(rng.randint(1, 9))))
+        futures = []
+        for i, (p, n) in enumerate(requests):
+            futures.append(s.submit(p, n))
+            if i % 4 == 0:
+                time.sleep(0.004)
+        oracle = model.generate_reference
+        for (p, n), f in zip(requests, futures):
+            assert f.result(60)["tokens"] == oracle(p, n)
+        dump = s.kv_dump()
+        assert dump["integrity"] == []
+        spec = dump["speculation"]
+        assert spec["spec_depth"] == 3
+        assert spec["rejected_tokens"] > 0
+        assert spec["draft_rollbacks"] > 0
+        assert spec["rolled_back_tokens"] >= spec["draft_rollbacks"]
+        stats = s.stats()
+        assert stats["active_sequences"] == 0
+        assert stats["prefix_hits"] > 0
+    finally:
+        s.close(drain=True)
+
+
+def test_spec_composes_with_prefix_reuse(toy, toy_oracle):
+    """Followers sharing a system prompt attach to resident blocks
+    while speculating — dedupe still fires, tokens stay bitwise."""
+    s = DecodeScheduler(toy, name="specreuse", **GEOM,
+                        prefix_caching=True, prefill_chunk_tokens=4,
+                        spec_depth=2)
+    try:
+        system = [7, 3, 7, 3, 5, 1, 5, 1]             # two full blocks
+        seed = system + [9]
+        assert s.generate(seed, 8, timeout=60)["tokens"] == \
+            toy_oracle(seed, 8)
+        followers = [system + [10 + i, 11 + i] for i in range(6)]
+        futures = [s.submit(p, 8) for p in followers]
+        for p, f in zip(followers, futures):
+            assert f.result(60)["tokens"] == toy_oracle(p, 8)
+        stats = s.stats()
+        assert stats["prefix_hits"] >= len(followers)
+        assert stats["dedup_blocks"] >= 2 * len(followers)
+        assert stats["accepted_tokens"] > 0
+        assert s.kv_dump()["integrity"] == []
+    finally:
+        s.close(drain=True)
+
+
+# -- migration / checkpoint mid-speculation -----------------------------------
+
+def test_mid_speculation_migration_bitwise(toy_oracle):
+    """Sessions exported between speculative iterations resume on a
+    peer (also speculating) with exactly the uninterrupted stream —
+    the exported length covers only emitted history, never a rejected
+    draft position."""
+    model = ToyDecodeModel(vocab=31, step_delay=0.05,
+                           draft_agreement=0.6)
+    a = DecodeScheduler(model, name="miga", **GEOM, spec_depth=2)
+    b = DecodeScheduler(model, name="migb", **GEOM, spec_depth=3)
+    try:
+        prompts = {"m%d" % i: [2, 4, 6, 8, 1, 3, 5, 7, 20 + i]
+                   for i in range(3)}
+        futures = {sid: a.submit(p, 8, session_id=sid)
+                   for sid, p in prompts.items()}
+        time.sleep(0.08)                       # a few iterations in
+        states = a.export_sessions()
+        assert states
+        exported = {st["session_id"] for st in states}
+        done, errors = b.import_sessions(
+            unpack_states(pack_states(states)))
+        assert errors == [] and set(done) == exported
+        a.release_migrated(done, target="peer:1")
+        oracle = model.generate_reference
+        for sid, p in prompts.items():
+            if sid in exported:
+                assert futures[sid].result(10)["migrated"]
+                kind, val = b.attach(sid)
+                result = val if kind == "finished" else val.result(60)
+            else:
+                result = futures[sid].result(60)
+            assert result["tokens"] == oracle(p, 8), sid
+        for s in (a, b):
+            assert s.kv_dump()["integrity"] == [], s.name
+    finally:
+        a.close(drain=True)
+        b.close(drain=True)
+
+
+def test_checkpoint_crosses_spec_boundary(tmp_path, toy_oracle):
+    """Speculation is a STRATEGY, not geometry: a checkpoint taken
+    mid-generation under speculation restores into a PLAIN scheduler
+    (and vice versa) and finishes with the same tokens."""
+    model = ToyDecodeModel(vocab=31, step_delay=0.05,
+                           draft_agreement=0.7)
+    s1 = DecodeScheduler(model, name="ckspeca", **GEOM, spec_depth=2)
+    s2 = None
+    try:
+        prompts = [[3, 1, 4, 1, 5, 9, 2, 6, 11], [7, 7], [8, 9, 10]]
+        futures = [s1.submit(p, 8) for p in prompts]
+        time.sleep(0.06)
+        path = s1.checkpoint_kv(str(tmp_path))
+        oracle = model.generate_reference
+        for p, f in zip(prompts, futures):
+            assert f.result(60)["tokens"] == oracle(p, 8)
+        s2 = DecodeScheduler(model, name="ckspecb", **GEOM)  # spec OFF
+        restored = s2.restore_kv(path)
+        assert restored
+        want = {tuple(oracle(p, 8)) for p in prompts}
+        got = {tuple(f.result(60)["tokens"])
+               for f in restored.values()}
+        assert got <= want and len(got) == len(restored)
+        assert s2.kv_dump()["integrity"] == []
+    finally:
+        s1.close(drain=True)
+        if s2 is not None:
+            s2.close(drain=True)
+
+
+# -- warm restart -------------------------------------------------------------
+
+def test_warm_restart_draft_verify_compile_nothing(tmp_path, toy,
+                                                   toy_oracle):
+    """The draft and verify executables ride the same persistent cache
+    + manifest as the decode step: a restart deserializes all four
+    (compiles == 0) and generates identical tokens."""
+    from veles_tpu.compilecache import (default_cache,
+                                        reset_default_caches)
+    from veles_tpu.config import root
+    prior = root.common.compile_cache.get("dir", None)
+    root.common.compile_cache.dir = str(tmp_path / "cache")
+    reset_default_caches()
+    try:
+        prompt = [5, 4, 3, 2, 1, 6, 7, 8, 9]
+        kw = dict(GEOM, prefill_chunk_tokens=4, spec_depth=2)
+        s1 = DecodeScheduler(toy, name="specres", **kw)
+        first = s1.stats()
+        r1 = s1.generate(prompt, 6, timeout=60)
+        s1.close(drain=True)
+        # decode + chunk + draft + verify, NO ladder
+        assert first["executables"] == 4
+        assert first["compiles"] == 4 and first["cache_hits"] == 0
+        s2 = DecodeScheduler(toy, name="specres", **kw)
+        warm = s2.stats()
+        r2 = s2.generate(prompt, 6, timeout=60)
+        assert s2.stats()["post_warmup_compiles"] == 0
+        s2.close(drain=True)
+        assert warm["compiles"] == 0
+        assert warm["cache_hits"] == warm["executables"] == 4
+        assert r1["tokens"] == r2["tokens"] == toy_oracle(prompt, 6)
+        manifest = default_cache().manifest
+        assert manifest.buckets("specres@draft") == [2]
+        assert manifest.buckets("specres@verify") == [2]
+    finally:
+        root.common.compile_cache.dir = prior
+        reset_default_caches()
+
+
+# -- metrics surface ----------------------------------------------------------
+
+def test_spec_metrics_series(toy, toy_oracle):
+    s = DecodeScheduler(toy, name="specmet", **GEOM, spec_depth=2)
+    try:
+        rng = numpy.random.RandomState(9)
+        for p, n in _requests(rng, 6):
+            assert s.generate(p, n, timeout=60)["tokens"] == \
+                toy_oracle(p, n)
+        m = s.metrics
+        assert m.draft_tokens > 0
+        assert m.accepted_tokens + m.rejected_tokens == m.draft_tokens
+        assert m.verify_steps > 0
+        rate = m.acceptance_rate()
+        assert rate is not None and 0.0 <= rate <= 1.0
+        snap = m.snapshot()
+        assert snap["acceptance_rate"] == round(rate, 4)
+        # emitted tokens == tokens counter: one per step per row PLUS
+        # the extra accepted ones — the sum must equal what was served
+        assert m.tokens == sum(
+            len(toy_oracle(p, n)) for p, n in _requests(
+                numpy.random.RandomState(9), 6))
+    finally:
+        s.close(drain=True)
+
+
+def test_plain_metrics_have_no_acceptance(toy):
+    s = DecodeScheduler(toy, name="plainmet", **GEOM)
+    try:
+        s.generate([1, 2, 3], 4, timeout=60)
+        assert s.metrics.acceptance_rate() is None
+        assert "acceptance_rate" not in s.metrics.snapshot()
+    finally:
+        s.close(drain=True)

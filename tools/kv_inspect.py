@@ -10,8 +10,8 @@ Usage::
 The decode-serving sibling of ``tools/ckpt_inspect.py``: where that tool
 re-hashes checkpoint chunks on disk, this one reads the scheduler's
 ``GET /api/<model>/kv`` snapshot — resident prefixes with refcounts, the
-refcount-0 LRU cache, dedupe counters, and the pool's own invariant
-check (free + live + shared + cached == capacity, no block in two
+refcount-0 LRU cache, dedupe counters, the speculative-decoding
+draft/accept/rollback tallies, and the pool's own invariant check (free + live + shared + cached == capacity, no block in two
 domains, no session referencing an unallocated block).  ``--verify``
 turns any violation into exit code 1, which is how the chaos drill
 (tools/serve_bench.py --chaos) asserts pool integrity on every replica
@@ -69,6 +69,16 @@ def describe(dump):
         % (dump["prefix_hits"], dump["dedup_blocks"],
            dump["published_blocks"], dump["dedup_ratio"],
            dump["evicted_blocks"]))
+    spec = dump.get("speculation")
+    if spec:
+        lines.append(
+            "  speculation: depth %d, %d drafted / %d accepted / %d "
+            "rejected (acceptance %s), %d rollback(s) over %d token(s)"
+            % (spec["spec_depth"], spec["draft_tokens"],
+               spec["accepted_tokens"], spec["rejected_tokens"],
+               "%.2f" % spec["acceptance_rate"]
+               if spec.get("acceptance_rate") is not None else "-",
+               spec["draft_rollbacks"], spec["rolled_back_tokens"]))
     for entry in dump["shared"]:
         lines.append("  shared  block %4d  key %s  refcount %d"
                      % (entry["block"], entry["key"],

@@ -595,6 +595,88 @@ def run_prefix_bench(shared_prefix=16, waves=10, long_prompts=3,
     return out
 
 
+# -- speculative decoding mode ------------------------------------------------
+
+
+def run_spec_bench(depths=(1, 2, 3, 4), agreement=0.8, n_requests=24,
+                   max_prompt_len=8, max_new_tokens=16,
+                   step_delay=0.002, rounds=2, cache_dir=None):
+    """The speculative-decoding acceptance sweep (ISSUE 15): the SAME
+    mixed request set served by the SAME toydecode model (pinned
+    per-verify-pass host cost, tunable drafter agreement) plain vs
+    draft-and-verify at each candidate depth.  Every emitted sequence
+    is first checked bitwise against the pure-host oracle — the
+    speedup table only counts if the tokens are identical; then each
+    depth's tok/s is measured interleaved with the plain baseline so
+    machine-load drift cancels out of the ratio.  The tok/s-vs-depth
+    curve crosses over where the acceptance rate stops paying for the
+    extra verify width; ``spec_best_depth`` is the measured knee."""
+    from veles_tpu.serving import DecodeScheduler
+    from veles_tpu.serving.toydecode import ToyDecodeModel
+
+    if cache_dir:
+        from veles_tpu.config import root
+        root.common.compile_cache.dir = cache_dir
+    model = ToyDecodeModel(vocab=31, step_delay=step_delay,
+                           draft_agreement=agreement)
+    requests = _decode_requests(n_requests, max_prompt_len,
+                                max_new_tokens, model.vocab)
+    oracle = [model.generate_reference(p, n) for p, n in requests]
+
+    def build(depth):
+        return DecodeScheduler(
+            model, max_batch=4, block_size=4,
+            max_prompt_len=max_prompt_len,
+            max_new_tokens=max_new_tokens, queue_limit=4096,
+            spec_depth=depth, name="spec_bench_d%s" % (depth or 0))
+
+    out = {"spec_requests": n_requests, "spec_agreement": agreement,
+           "spec_step_delay_s": step_delay,
+           "spec_max_new_tokens": max_new_tokens,
+           "spec_depths": [int(d) for d in depths]}
+    schedulers = {0: build(None)}       # 0 = the plain scheduler
+    for d in depths:
+        schedulers[int(d)] = build(int(d))
+    try:
+        # correctness first (also the untimed warm pass): every
+        # sequence from every variant must match the oracle bitwise
+        mismatches = 0
+        for s in schedulers.values():
+            _tok, _dt, results = _run_continuous(s, requests)
+            mismatches += sum(1 for r, want in zip(results, oracle)
+                              if r["tokens"] != want)
+        out["spec_token_mismatches"] = mismatches
+        out["spec_tokens_match"] = mismatches == 0
+        warm = {d: s.stats()["compiles"] for d, s in schedulers.items()}
+        acc = {d: {"tokens": 0, "t": 0.0} for d in schedulers}
+        for _ in range(max(1, rounds)):    # interleaved: drift cancels
+            for d, s in schedulers.items():
+                tok, dt, _res = _run_continuous(s, requests)
+                acc[d]["tokens"] += tok
+                acc[d]["t"] += dt
+        plain = acc[0]["tokens"] / acc[0]["t"]
+        out["spec_plain_tok_s"] = round(plain, 1)
+        best_depth, best = None, 0.0
+        for d in sorted(set(int(d) for d in depths)):
+            rate = acc[d]["tokens"] / acc[d]["t"]
+            out["spec_tok_s_depth%d" % d] = round(rate, 1)
+            out["spec_acceptance_depth%d" % d] = \
+                schedulers[d].stats()["acceptance_rate"]
+            if rate > best:
+                best_depth, best = d, rate
+        out["spec_best_depth"] = best_depth
+        out["spec_best_tok_s"] = round(best, 1)
+        out["spec_best_speedup"] = round(best / plain, 2) \
+            if plain else None
+        out["spec_post_warmup_compiles"] = sum(
+            s.stats()["compiles"] - warm[d]
+            for d, s in schedulers.items())
+    finally:
+        for s in schedulers.values():
+            s.close(drain=True)
+    return out
+
+
 # -- fleet load mode ----------------------------------------------------------
 #
 # The multi-replica counterpart (ISSUE 7): the SAME open/closed-loop
@@ -1062,6 +1144,14 @@ def main(argv=None):
     p.add_argument("--prefix-waves", type=int, default=10,
                    help="head-of-line waves per variant "
                         "(--shared-prefix mode)")
+    p.add_argument("--spec-depth", default=None, metavar="K[,K2,...]",
+                   help="speculative decoding sweep: plain decode vs "
+                        "draft-and-verify at each listed depth on the "
+                        "toydecode stand-in (pinned per-verify-pass "
+                        "host cost, tunable drafter agreement)")
+    p.add_argument("--spec-agree", type=float, default=0.8,
+                   help="drafter agreement rate for the --spec-depth "
+                        "sweep (0..1; the acceptance-rate dial)")
     p.add_argument("--cache-dir", default=None,
                    help="persistent executable cache dir (decode mode; "
                         "run twice to prove the zero-recompile warm "
@@ -1137,6 +1227,32 @@ def main(argv=None):
                      out.get("fleet_respawn_compiles"),
                      out.get("fleet_rollout_failed"),
                      out.get("fleet_rollout_s")), file=sys.stderr)
+        print(json.dumps(line))
+        return 0
+
+    if args.spec_depth:
+        out = run_spec_bench(
+            depths=tuple(int(d) for d in args.spec_depth.split(",")),
+            agreement=args.spec_agree, cache_dir=args.cache_dir)
+        line = {"metric": "spec_best_speedup",
+                "value": out.get("spec_best_speedup"), "unit": "x"}
+        line.update(out)
+        if not args.json:
+            depth_cols = ", ".join(
+                "d%d %s tok/s (acc %s)"
+                % (d, out.get("spec_tok_s_depth%d" % d),
+                   out.get("spec_acceptance_depth%d" % d))
+                for d in out["spec_depths"])
+            print("spec bench: plain %s tok/s vs %s; best depth %s = "
+                  "%sx at agreement %s, oracle match=%s, %s "
+                  "post-warmup compiles"
+                  % (out.get("spec_plain_tok_s"), depth_cols,
+                     out.get("spec_best_depth"),
+                     out.get("spec_best_speedup"),
+                     out.get("spec_agreement"),
+                     out.get("spec_tokens_match"),
+                     out.get("spec_post_warmup_compiles")),
+                  file=sys.stderr)
         print(json.dumps(line))
         return 0
 

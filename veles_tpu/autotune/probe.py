@@ -374,6 +374,58 @@ def probe_prefill_chunk(config, ctx, reps, windows):
             "cand_s": cand_s, "ref_s": ref_s}
 
 
+def probe_spec_depth(config, ctx, reps, windows):
+    """Decode drain time with the draft-and-verify loop at the
+    candidate depth — what the depth trades is accepted tokens per
+    verify pass vs wasted draft/verify work on rejections — gated on
+    token-exactness vs the pure-host oracle.  Runs on the toydecode
+    stand-in with a pinned per-step host delay and a pinned drafter
+    agreement rate so scheduling, not XLA, is what's measured."""
+    import numpy
+    from veles_tpu.serving import DecodeScheduler
+    from veles_tpu.serving.toydecode import ToyDecodeModel
+    max_prompt = int(ctx.get("max_prompt_len", 8))
+    max_new = int(ctx.get("max_new_tokens", 16))
+    n_requests = int(ctx.get("requests", 8))
+    agree = float(ctx.get("agreement", 0.8))
+    sdelay = float(ctx.get("step_delay", 0.002))
+    model = ToyDecodeModel(vocab=31, step_delay=sdelay,
+                           draft_agreement=agree)
+    rng = numpy.random.RandomState(int(ctx.get("seed", 0)))
+    prompts = [[int(t) for t in rng.randint(
+        0, 31, size=rng.randint(1, max_prompt + 1))]
+        for _ in range(n_requests)]
+
+    def build(depth):
+        return DecodeScheduler(
+            model, max_batch=4, block_size=4,
+            max_prompt_len=max_prompt, max_new_tokens=max_new,
+            queue_limit=4 * n_requests, warmup=True, cache=False,
+            spec_depth=int(depth),
+            name="autotune-spec%d" % depth)
+
+    from veles_tpu.autotune.space import site
+    cand = build(config["spec_depth"])
+    ref = build(site("serving.spec_depth").default["spec_depth"])
+    try:
+        def drain(s):
+            futs = [s.submit(p, max_new) for p in prompts]
+            return [f.result(120) for f in futs]
+
+        outs = drain(cand)
+        ok = all(outs[i]["tokens"] == model.generate_reference(
+                     prompts[i], max_new)
+                 for i in range(n_requests))
+        cand_s, ref_s = _timed_pair(lambda: drain(cand),
+                                    lambda: drain(ref), reps, windows)
+    finally:
+        cand.close(drain=False)
+        ref.close(drain=False)
+    return {"gate": _gate(ok, "tokens diverge from the pure-host "
+                              "oracle"),
+            "cand_s": cand_s, "ref_s": ref_s}
+
+
 _IMPLS = {
     "lrn": probe_lrn,
     "flash_attention": probe_flash_attention,
@@ -383,13 +435,14 @@ _IMPLS = {
     "serving.bucket_ladder": probe_bucket_ladder,
     "serving.decode": probe_serving_decode,
     "serving.prefill_chunk": probe_prefill_chunk,
+    "serving.spec_depth": probe_spec_depth,
 }
 
 #: cheap serving probes need fewer reps than μs-scale kernels
 _DEFAULT_REPS = {"serving.bucket_ladder": 1, "serving.decode": 1,
-                 "serving.prefill_chunk": 1}
+                 "serving.prefill_chunk": 1, "serving.spec_depth": 1}
 _DEFAULT_WINDOWS = {"serving.bucket_ladder": 2, "serving.decode": 2,
-                    "serving.prefill_chunk": 2}
+                    "serving.prefill_chunk": 2, "serving.spec_depth": 2}
 
 
 def main(argv=None):

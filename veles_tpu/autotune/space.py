@@ -7,7 +7,7 @@ same: the site declares *every* candidate up front with validity
 constraints, the runner measures, and only a measured, correctness-
 gated winner is ever persisted.
 
-Eight builtin sites cover the tree's tunables:
+Nine builtin sites cover the tree's tunables:
 
 ==================== ======================================== ===========
 site                 parameters                               dispatch at
@@ -20,6 +20,7 @@ paged_attention      block_size                               serving/decode.py
 serving.bucket_ladder shape (pow2|coarse|dense)               serving/scheduler.py
 serving.decode       max_batch, block_size                    serving/decode.py
 serving.prefill_chunk chunk_tokens                            serving/decode.py
+serving.spec_depth   spec_depth                               serving/decode.py
 ==================== ======================================== ===========
 
 Every site's ``default`` is the exact hand-picked configuration the
@@ -253,6 +254,25 @@ _register(SearchSpace(
     description="prefill chunk size: short-request TTFT under "
                 "head-of-line long prefills vs per-chunk dispatch "
                 "overhead"))
+
+
+def _spec_constraint(cfg, ctx):
+    # speculating past the per-request token budget only writes
+    # positions the accept step must discard — keep candidates distinct
+    mn = ctx.get("max_new_tokens")
+    return mn is None or cfg["spec_depth"] < max(int(mn), 2)
+
+
+_register(SearchSpace(
+    "serving.spec_depth",
+    params={"spec_depth": (1, 2, 3, 4, 6, 8)},
+    default={"spec_depth": 2},       # decode.DEFAULT_SPEC_DEPTH
+    constraint=_spec_constraint,
+    classify=lambda ctx: "mn%d" % pow2_bucket(
+        ctx.get("max_new_tokens", 32)),
+    description="speculative decoding depth: draft tokens per "
+                "iteration — measured acceptance rate vs the "
+                "multi-token verify pass's cost"))
 
 
 def site(name):

@@ -29,6 +29,19 @@ every request —
   non-resident suffix, chunk by chunk — shared system prompts and
   multi-turn re-submissions skip most of their prefill.  Both knobs
   default OFF, which is bit-for-bit the historical behavior;
+- with ``spec_depth`` set, decode runs **speculatively**: a cheap
+  drafter (``model.draft_fn``) proposes k tokens per row per
+  iteration, and the target verifies all k+1 positions in ONE batched
+  pass (``model.verify_fn`` → the multi-token
+  :func:`~veles_tpu.znicz.paged_attention.paged_verify_attention`
+  entry of the same ragged kernel).  Greedy rejection sampling —
+  accept the longest draft prefix the target agrees with, plus the
+  target's own correction token — makes the emitted stream
+  token-for-token identical to plain decode; K/V written for rejected
+  positions is rolled back by NOT advancing the length over it (the
+  kernel's length masking hides it until overwritten), so rejected
+  content is never published, shared, or exported.  The knob defaults
+  OFF, which is bit-for-bit the plain per-token step;
 - K/V lives in fixed-size blocks of a preallocated device pool
   (:mod:`.kvcache` owns placement; znicz/paged_attention.py gathers
   through the page table), so memory is allocated per sequence LENGTH,
@@ -71,6 +84,10 @@ _FINISHED_KEEP = 256
 #: hand-picked prefill chunk size (tokens per chunk executable call) —
 #: the ``serving.prefill_chunk`` autotune site's baseline candidate
 DEFAULT_PREFILL_CHUNK = 32
+
+#: hand-picked speculation depth (draft tokens per iteration) — the
+#: ``serving.spec_depth`` autotune site's baseline candidate
+DEFAULT_SPEC_DEPTH = 2
 
 
 class _Request:
@@ -143,7 +160,8 @@ class DecodeScheduler:
                  max_prompt_len=32, max_new_tokens=32, num_blocks=None,
                  queue_limit=64, name="decode", metrics=None,
                  cache=None, manifest=None, warmup=True,
-                 prefix_caching=False, prefill_chunk_tokens=None):
+                 prefix_caching=False, prefill_chunk_tokens=None,
+                 spec_depth=None):
         self.name = name
         self.model = model
         self.max_prompt_len = int(max_prompt_len)
@@ -179,6 +197,32 @@ class DecodeScheduler:
             raise ValueError(
                 "model %r has no prefill_chunk_fn; chunked prefill "
                 "is unavailable for it" % getattr(model, "name", model))
+        # speculation depth is a TUNABLE SITE too (serving.spec_depth):
+        # an int pins k, "auto" consults the tuning store (measured
+        # acceptance rate vs verify cost), None (default) keeps the
+        # plain per-token step exactly
+        self._spec_source = None
+        spec = spec_depth
+        if spec == "auto":
+            from ..autotune import dispatch as _autotune
+            from ..autotune.space import pow2_bucket
+            cfg_s, self._spec_source = _autotune.resolve(
+                "serving.spec_depth",
+                "mn%d" % pow2_bucket(self.max_new_tokens),
+                default={"spec_depth": DEFAULT_SPEC_DEPTH})
+            spec = cfg_s["spec_depth"]
+        elif spec is not None:
+            self._spec_source = "explicit"
+        self.spec_depth = None if spec is None else int(spec)
+        if self.spec_depth is not None and self.spec_depth < 1:
+            raise ValueError("spec_depth must be >= 1 (or None to "
+                             "disable speculative decoding)")
+        if self.spec_depth and not (hasattr(model, "draft_fn")
+                                    and hasattr(model, "verify_fn")):
+            raise ValueError(
+                "model %r has no draft_fn/verify_fn; speculative "
+                "decoding is unavailable for it"
+                % getattr(model, "name", model))
         # the decode geometry is a TUNABLE SITE (serving.decode):
         # explicit kwargs pin it; otherwise a tuning record for this
         # context-length class picks the measured (max_batch,
@@ -247,9 +291,25 @@ class DecodeScheduler:
             self._chunk_jit = jax.jit(
                 model.prefill_chunk_fn(self.block_size),
                 donate_argnums=(3, 4))
+        self._draft_jit = self._verify_jit = None
+        if self.spec_depth:
+            # the drafter only READS the pools (no donation — the
+            # verify pass reuses them); verify donates like decode
+            self._draft_jit = jax.jit(
+                model.draft_fn(self.block_size, self.spec_depth))
+            self._verify_jit = jax.jit(
+                model.verify_fn(self.block_size, self.spec_depth),
+                donate_argnums=(0, 1))
         self._decode_exe = None
         self._chunk_exe = None
+        self._draft_exe = None
+        self._verify_exe = None
         self._prefill_exes = {}
+        # lifetime speculation counters (stats()/kv_dump alongside the
+        # registry-backed metrics series)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_rejected = 0
         self._compiles = 0
         self._cache_hits = 0
         self._compile_seconds = 0.0
@@ -277,6 +337,10 @@ class DecodeScheduler:
             self._manifest.record_config(
                 self.name, "serving.prefill_chunk",
                 {"chunk_tokens": self.chunk_tokens})
+        if self._manifest is not None and self._spec_source == "tuned":
+            self._manifest.record_config(
+                self.name, "serving.spec_depth",
+                {"spec_depth": self.spec_depth})
         self._warmed = False
         if warmup:
             self.warmup()
@@ -354,6 +418,47 @@ class DecodeScheduler:
                                               bucket)
         return exe
 
+    def _get_draft_exe(self):
+        if self._draft_exe is None:
+            with self._compile_lock:
+                if self._draft_exe is None:
+                    jax = self._jax
+                    kps, vps = self._pool_structs()
+                    self._draft_exe = self._aot(
+                        self._draft_jit, kps, vps,
+                        jax.ShapeDtypeStruct(self._np_table.shape,
+                                             numpy.int32),
+                        jax.ShapeDtypeStruct((self.max_batch,),
+                                             numpy.int32),
+                        jax.ShapeDtypeStruct((self.max_batch,),
+                                             numpy.int32),
+                        tag="draft%d" % self.spec_depth)
+                    if self._manifest is not None:
+                        self._manifest.record(self.name + "@draft",
+                                              self.spec_depth)
+        return self._draft_exe
+
+    def _get_verify_exe(self):
+        if self._verify_exe is None:
+            with self._compile_lock:
+                if self._verify_exe is None:
+                    jax = self._jax
+                    kps, vps = self._pool_structs()
+                    self._verify_exe = self._aot(
+                        self._verify_jit, kps, vps,
+                        jax.ShapeDtypeStruct(self._np_table.shape,
+                                             numpy.int32),
+                        jax.ShapeDtypeStruct((self.max_batch,),
+                                             numpy.int32),
+                        jax.ShapeDtypeStruct(
+                            (self.max_batch, self.spec_depth + 1),
+                            numpy.int32),
+                        tag="verify%d" % self.spec_depth)
+                    if self._manifest is not None:
+                        self._manifest.record(self.name + "@verify",
+                                              self.spec_depth)
+        return self._verify_exe
+
     def _get_chunk_exe(self):
         if self._chunk_exe is None:
             with self._compile_lock:
@@ -391,6 +496,9 @@ class DecodeScheduler:
         every prompt runs through it) — one more AOT entry in the
         warmup manifest, one less reason for a restart to compile."""
         self._get_decode_exe()
+        if self.spec_depth:
+            self._get_draft_exe()
+            self._get_verify_exe()
         if self.chunk_tokens:
             self._get_chunk_exe()
         else:
@@ -492,7 +600,10 @@ class DecodeScheduler:
             if self._chunking:
                 self._chunk_step()
             if self._sessions:
-                self._step()
+                if self.spec_depth:
+                    self._spec_step()
+                else:
+                    self._step()
             elif stop and not self._pending and not self._chunking:
                 return
 
@@ -784,6 +895,87 @@ class DecodeScheduler:
                 self._retire(session)
         self.metrics.record_step(len(active), self.max_batch, dt)
 
+    def _spec_step(self):
+        """One speculative iteration: draft k tokens per row, verify
+        all k+1 fed positions in one batched pass, accept greedily.
+
+        The verify output at position ``i`` is the target's next token
+        given the history plus the fed tokens ``0 .. i`` — so the
+        longest prefix where ``draft[i] == out[i - 1]`` consists of
+        tokens plain decode would have emitted, and ``out[m]`` (the
+        correction) is the target's own token after them.  Every
+        emitted token is therefore exactly the plain-decode stream;
+        speculation only changes how many arrive per iteration.
+
+        Rollback: the verify pass wrote K/V at ``length .. length+k``,
+        but ``length`` only advances over the emitted tokens — the
+        positions past it stay masked by the kernel (and the toy
+        model's gather) until the next iteration overwrites them.
+        Because length never covers rejected content, history
+        publication (:meth:`_publish_history`), export and
+        ``checkpoint_kv`` can never leak it.
+        """
+        k = self.spec_depth
+        draft_run = self._get_draft_exe()
+        verify_run = self._get_verify_exe()
+        t0 = time.perf_counter()
+        drafts = numpy.asarray(draft_run(
+            self._k_pools, self._v_pools, self._np_table,
+            self._np_lengths, self._np_tokens))          # [B, k]
+        ddelay = getattr(self.model, "draft_host_delay", 0)
+        if ddelay:
+            time.sleep(ddelay)
+        ddt = time.perf_counter() - t0
+        fed = numpy.concatenate(
+            [self._np_tokens[:, None], drafts],
+            axis=1).astype(numpy.int32)                  # [B, k+1]
+        t1 = time.perf_counter()
+        out, self._k_pools, self._v_pools = verify_run(
+            self._k_pools, self._v_pools, self._np_table,
+            self._np_lengths, fed)
+        out = numpy.asarray(out)                         # D2H sync
+        delay = getattr(self.model, "step_host_delay", 0)
+        if delay:
+            time.sleep(delay)
+        vdt = time.perf_counter() - t1
+        active = list(self._sessions.values())
+        accepted_total = emitted_total = 0
+        for session in active:
+            row = session.row
+            accepted = 0
+            while (accepted < k and
+                   int(drafts[row, accepted]) == int(out[row, accepted])):
+                accepted += 1
+            remaining = (session.req.max_new_tokens
+                         - len(session.generated))
+            emit = [int(t) for t in out[row, :accepted + 1][:remaining]]
+            # roll back every written-but-unemitted position (rejected
+            # drafts + accepted tail past the token budget)
+            self._pool.note_draft_rollback(k + 1 - len(emit))
+            for token in emit:
+                session.length += 1      # the fed token is now cached
+                session.generated.append(token)
+            session.next_input = emit[-1]
+            self._np_lengths[row] = session.length
+            self._np_tokens[row] = session.next_input
+            accepted_total += accepted
+            emitted_total += len(emit)
+            if session.done:
+                self._retire(session)
+        rejected_total = len(active) * k - accepted_total
+        self._spec_drafted += len(active) * k
+        self._spec_accepted += accepted_total
+        self._spec_rejected += rejected_total
+        self.metrics.record_draft(len(active), k, ddt)
+        self.metrics.record_verify(len(active), k + 1, accepted_total,
+                                   rejected_total, vdt)
+        # record_step's token accounting counts EMITTED tokens: one per
+        # active row like plain decode, plus the extra accepted ones
+        self.metrics.record_step(len(active), self.max_batch, vdt)
+        extra = emitted_total - len(active)
+        if extra > 0:
+            self.metrics.record_extra_tokens(extra)
+
     def _retire(self, session, error=None):
         self._sessions.pop(session.row, None)
         self._by_sid.pop(session.req.sid, None)
@@ -1019,6 +1211,19 @@ class DecodeScheduler:
                     chunking_sessions=len(self._chunking),
                     sessions=sessions,
                     integrity=problems)
+        if self.spec_depth:
+            drafted = self._spec_drafted
+            dump["speculation"] = {
+                "spec_depth": self.spec_depth,
+                "draft_tokens": drafted,
+                "accepted_tokens": self._spec_accepted,
+                "rejected_tokens": self._spec_rejected,
+                "acceptance_rate":
+                    round(self._spec_accepted / drafted, 4)
+                    if drafted else None,
+                "draft_rollbacks": self._pool.draft_rollbacks,
+                "rolled_back_tokens": self._pool.rolled_back_tokens,
+            }
         return dump
 
     def spill_session(self, session_id, directory):
@@ -1299,6 +1504,8 @@ class DecodeScheduler:
             "buckets": list(self.prefill_buckets),
             "executables": (1 if self._decode_exe is not None else 0)
             + (1 if self._chunk_exe is not None else 0)
+            + (1 if self._draft_exe is not None else 0)
+            + (1 if self._verify_exe is not None else 0)
             + len(self._prefill_exes),
             "compiles": self._compiles,
             "cache_hits": self._cache_hits,
@@ -1326,6 +1533,17 @@ class DecodeScheduler:
         }
         if self._chunk_source is not None:
             out["chunk_source"] = self._chunk_source
+        if self.spec_depth:
+            drafted = self._spec_drafted
+            out.update(
+                spec_depth=self.spec_depth,
+                spec_source=self._spec_source,
+                draft_tokens=drafted,
+                accepted_tokens=self._spec_accepted,
+                rejected_tokens=self._spec_rejected,
+                acceptance_rate=round(self._spec_accepted / drafted, 4)
+                if drafted else None,
+                rolled_back_tokens=self._pool.rolled_back_tokens)
         if self.prefix_caching:
             out.update(prefix_hits=pool["prefix_hits"],
                        dedup_blocks=pool["dedup_blocks"],

@@ -103,6 +103,11 @@ class KVBlockPool:
         self.dedup_blocks = 0            # blocks attached already-resident
         self.published_blocks = 0
         self.evicted_blocks = 0
+        # speculative-decoding rollback accounting: token positions the
+        # verify pass wrote but the accept step discarded (their blocks
+        # stay private and masked — never published, never readable)
+        self.draft_rollbacks = 0         # spec iterations that rolled back
+        self.rolled_back_tokens = 0      # positions written-then-discarded
 
     @property
     def free_blocks(self):
@@ -244,6 +249,17 @@ class KVBlockPool:
                 del self._refs[b]
                 self._cached[b] = self._key_of[b]   # newest = last
 
+    def note_draft_rollback(self, tokens):
+        """Record one speculative iteration discarding ``tokens``
+        written-but-rejected positions.  Pure accounting: the rollback
+        itself is the scheduler not advancing the sequence length over
+        them (the kernel's length masking keeps them invisible until
+        overwritten), so no block ever changes domain here — which is
+        exactly why rejected content can never be published or shared."""
+        if tokens > 0:
+            self.draft_rollbacks += 1
+            self.rolled_back_tokens += int(tokens)
+
     def is_shared(self, block):
         return int(block) in self._refs
 
@@ -324,6 +340,8 @@ class KVBlockPool:
             "dedup_blocks": self.dedup_blocks,
             "published_blocks": self.published_blocks,
             "evicted_blocks": self.evicted_blocks,
+            "draft_rollbacks": self.draft_rollbacks,
+            "rolled_back_tokens": self.rolled_back_tokens,
             "dedup_ratio": round(self.dedup_blocks / alloc_total, 4)
                            if alloc_total else 0.0,
             "integrity": self.check_integrity(),

@@ -286,7 +286,20 @@ _DECODE_COUNTERS = {
     "chunks": ("veles_serving_prefill_chunks_total",
                "Prefill chunk executions (the one-executable chunked "
                "path interleaved with decode steps)"),
+    "draft_tokens": ("veles_serving_spec_draft_tokens_total",
+                     "Draft tokens proposed by the speculative "
+                     "drafter"),
+    "accepted_tokens": ("veles_serving_spec_accepted_tokens_total",
+                        "Draft tokens the verify pass accepted"),
+    "rejected_tokens": ("veles_serving_spec_rejected_tokens_total",
+                        "Draft tokens the verify pass rejected "
+                        "(their KV writes are rolled back)"),
+    "verify_steps": ("veles_serving_spec_verify_steps_total",
+                     "Speculative verify-pass executions"),
 }
+
+#: draft/accept outcomes kept for the per-window acceptance-rate gauge
+_ACCEPT_WINDOW = 1024
 
 #: resident-prefix fraction bands of the split TTFT histogram: how much
 #: of the prompt was already cached when the sequence was admitted
@@ -355,6 +368,19 @@ class DecodeMetrics:
             "veles_serving_decode_step_quantile_ms",
             "Exact decode-step quantiles over the recent window",
             ("model", "quantile"))
+        # speculation series: verify-batch-size histogram + a windowed
+        # acceptance-rate gauge (refreshed at scrape time from the
+        # recent (drafted, accepted) pairs — a lifetime ratio would
+        # hide acceptance drifting with the workload)
+        self._h_verify = self.registry.histogram(
+            "veles_serving_spec_verify_batch_tokens",
+            "Tokens per speculative verify pass (rows x (depth + 1))",
+            ("model",)).labels(model=model)
+        self._g_acceptance = self.registry.gauge(
+            "veles_serving_spec_acceptance_rate",
+            "Accepted / drafted tokens over the recent window",
+            ("model",)).labels(model=model)
+        self._acceptance = collections.deque(maxlen=_ACCEPT_WINDOW)
         self.registry.register_collector(self)
         self._emissions = collections.deque(maxlen=self.RATE_WINDOW)
 
@@ -413,6 +439,46 @@ class DecodeMetrics:
         events.span("serving.decode", seconds, model=self.model,
                     rows=int(active_rows), max_rows=int(max_rows))
 
+    def record_extra_tokens(self, n):
+        """Tokens emitted beyond one-per-row in a speculative
+        iteration (accepted drafts) — keeps the tokens counter and the
+        recent-tok/s window honest about the speculation win."""
+        self._c["tokens"].inc(int(n))
+        with self._lock:
+            self._emissions.append((time.time(), int(n)))
+
+    def record_draft(self, rows, depth, seconds):
+        """One drafter execution: ``rows`` live rows each proposed
+        ``depth`` tokens."""
+        self._c["draft_tokens"].inc(int(rows) * int(depth))
+        events.span("serving.draft", seconds, model=self.model,
+                    rows=int(rows), depth=int(depth))
+
+    def record_verify(self, rows, span, accepted, rejected, seconds):
+        """One verify pass over ``rows`` live rows x ``span`` fed
+        positions; ``accepted``/``rejected`` are the batch-total draft
+        outcomes the host-side accept step decided."""
+        self._c["verify_steps"].inc()
+        self._c["accepted_tokens"].inc(int(accepted))
+        self._c["rejected_tokens"].inc(int(rejected))
+        self._h_verify.observe(int(rows) * int(span))
+        with self._lock:
+            self._acceptance.append((int(accepted) + int(rejected),
+                                     int(accepted)))
+        events.span("serving.verify", seconds, model=self.model,
+                    rows=int(rows), span=int(span),
+                    accepted=int(accepted), rejected=int(rejected))
+
+    def acceptance_rate(self):
+        """Accepted / drafted over the recent window (None before any
+        speculative step)."""
+        with self._lock:
+            pairs = list(self._acceptance)
+        drafted = sum(d for d, _ in pairs)
+        if not drafted:
+            return None
+        return sum(a for _, a in pairs) / drafted
+
     def record_complete(self, generated, ok=True):
         self._c["completed" if ok else "failed"].inc()
 
@@ -440,6 +506,9 @@ class DecodeMetrics:
             if value is not None:
                 self._g_quantile.labels(model=self.model,
                                         quantile=q).set(value)
+        rate = self.acceptance_rate()
+        if rate is not None:
+            self._g_acceptance.set(rate)
 
     # -- reader --------------------------------------------------------------
     def snapshot(self):
@@ -465,4 +534,7 @@ class DecodeMetrics:
             "step_latency": self.step_latency.summary(),
             "ttft": self.ttft.summary(),
         })
+        rate = self.acceptance_rate()
+        if rate is not None:
+            out["acceptance_rate"] = round(rate, 4)
         return out

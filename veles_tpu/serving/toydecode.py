@@ -24,12 +24,25 @@ exactly.
 Spec form (fleet replicas, ``--model NAME=SPEC``)::
 
     toydecode:vocab=97,delay=0.02,max_batch=4,block=4,max_prompt=16,max_new=32
+
+Speculative decoding: the drafter replays the exact recurrence WITHOUT
+writing the pools (so drafting is pure reads), then deterministically
+corrupts a tunable fraction of proposals — ``agree=0.6`` gives a
+drafter that agrees with the target on ~60% of positions, which is how
+acceptance-rate sweeps run on CPU with zero model-quality noise.
+Corruption only costs acceptance, never correctness: the verify pass
+recomputes every position from the cache, so emitted tokens are always
+the plain-decode tokens.
 """
 
 __all__ = ["ToyDecodeModel", "from_spec"]
 
 #: mixing constants of the token recurrence (arbitrary small primes)
 _A, _B, _C, _D = 31, 7, 13, 17
+
+#: modulus/multipliers of the drafter's deterministic corruption hash
+#: (position- and token-dependent, bounded far below int32 overflow)
+_AGREE_MOD, _AGREE_P1, _AGREE_P2 = 997, 403, 577
 
 
 def _next_token(cache, last, vocab):
@@ -50,7 +63,8 @@ class ToyDecodeModel:
     kind = "decode"
 
     def __init__(self, vocab=97, step_delay=0.0, prefill_delay=0.0,
-                 decode_defaults=None):
+                 decode_defaults=None, draft_agreement=1.0,
+                 draft_delay=0.0):
         self.vocab = int(vocab)
         if self.vocab < 2:
             raise ValueError("vocab must be >= 2")
@@ -59,6 +73,14 @@ class ToyDecodeModel:
         # honored by the prefill paths: host sleep per PROMPT TOKEN
         # actually processed (chunks pay only their own tokens)
         self.prefill_host_delay = float(prefill_delay)
+        # honored by the speculative step: host sleep per DRAFT call
+        # (models the drafter being cheaper than the target)
+        self.draft_host_delay = float(draft_delay)
+        # fraction of draft positions proposed correctly (tunable
+        # agreement rate — see module docstring)
+        self.draft_agreement = float(draft_agreement)
+        if not 0.0 <= self.draft_agreement <= 1.0:
+            raise ValueError("draft_agreement must be in [0, 1]")
         # geometry the registry applies when serving this model
         # (registry defaults < these < explicit kwargs)
         self.decode_defaults = dict(decode_defaults or {})
@@ -154,6 +176,83 @@ class ToyDecodeModel:
 
         return decode
 
+    def draft_fn(self, block_size, depth):
+        """Drafter: propose ``depth`` tokens per row by replaying the
+        recurrence forward from the cache sums — pure reads, the pools
+        are never written.  Proposals are deterministically corrupted
+        at ``1 - draft_agreement`` of positions (hash of cache length
+        and last token), so acceptance rate is tunable while the
+        emitted output stays byte-identical to plain decode."""
+        import jax.numpy as jnp
+        depth = int(depth)
+        vocab = self.vocab
+        agree_cut = int(round(self.draft_agreement * _AGREE_MOD))
+
+        def draft(k_pools, v_pools, page_table, lengths, tokens):
+            k, v = k_pools[0], v_pools[0]
+            flat_k = k[page_table].reshape(tokens.shape[0], -1)
+            flat_v = v[page_table].reshape(tokens.shape[0], -1)
+            pos = jnp.arange(flat_k.shape[1], dtype=jnp.int32)[None, :]
+            mask = pos < lengths[:, None]
+            s1 = jnp.sum(jnp.where(mask, flat_k, 0), axis=1)
+            s2 = jnp.sum(jnp.where(mask, flat_v, 0), axis=1)
+            t = tokens
+            proposals = []
+            for i in range(depth):
+                s1 = s1 + t
+                s2 = s2 + 3 * t + 1
+                cnt = lengths + 1 + i
+                nxt = (s1 * _A + s2 * _B + t * _C + cnt * _D) % vocab
+                bucket = ((cnt % _AGREE_MOD) * _AGREE_P1
+                          + (t % _AGREE_MOD) * _AGREE_P2) % _AGREE_MOD
+                nxt = jnp.where(bucket < agree_cut, nxt,
+                                (nxt + 1) % vocab)
+                proposals.append(nxt.astype(jnp.int32))
+                t = nxt
+            return jnp.stack(proposals, axis=1)
+
+        return draft
+
+    def verify_fn(self, block_size, depth):
+        """Target verify: write all ``depth + 1`` fed tokens (the next
+        input plus the drafts), then compute the recurrence at EVERY
+        fed position — ``out[:, i]`` is the plain-decode next token
+        given the history plus fed tokens ``0 .. i``, masked exactly
+        like the ragged verify attention entry (per-position causal
+        lengths).  Writes past a row's block capacity land in the
+        trash block; writes past the accepted prefix are rolled back
+        by simply not advancing ``lengths`` (they stay masked until
+        overwritten)."""
+        import jax.numpy as jnp
+        bs = int(block_size)
+        vocab = self.vocab
+
+        def verify(k_pools, v_pools, page_table, lengths, tokens):
+            k, v = k_pools[0], v_pools[0]
+            b, s = tokens.shape
+            nb = page_table.shape[1]
+            rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+            pos = (lengths[:, None]
+                   + jnp.arange(s, dtype=jnp.int32)[None, :])
+            dest = jnp.where(pos < nb * bs,
+                             page_table[rows, jnp.minimum(pos // bs,
+                                                          nb - 1)], 0)
+            off = pos % bs
+            k = k.at[dest, off].set(tokens)
+            v = v.at[dest, off].set(3 * tokens + 1)
+            flat_k = k[page_table].reshape(b, -1)
+            flat_v = v[page_table].reshape(b, -1)
+            gpos = jnp.arange(flat_k.shape[1],
+                              dtype=jnp.int32)[None, None, :]
+            count = pos + 1              # cache size at each position
+            mask = gpos < count[:, :, None]
+            s1 = jnp.sum(jnp.where(mask, flat_k[:, None, :], 0), axis=2)
+            s2 = jnp.sum(jnp.where(mask, flat_v[:, None, :], 0), axis=2)
+            nxt = (s1 * _A + s2 * _B + tokens * _C + count * _D) % vocab
+            return nxt.astype(jnp.int32), (k,), (v,)
+
+        return verify
+
     def generate_reference(self, prompt, max_new_tokens):
         """Cache-free host oracle: the tokens an uninterrupted
         generation emits (pure python ints — usable cross-process
@@ -181,10 +280,12 @@ _GEOM_KEYS = {"max_batch": "max_batch", "block": "block_size",
 
 def from_spec(spec):
     """``toydecode:key=value,...`` → :class:`ToyDecodeModel` carrying
-    its scheduler geometry in ``decode_defaults`` (vocab/delay are
-    model knobs; the rest are geometry)."""
+    its scheduler geometry in ``decode_defaults`` (vocab/delay/agree
+    are model knobs; the rest are geometry).  ``spec=K`` (or
+    ``spec=auto``) turns on speculative decoding at depth K."""
     body = spec.partition(":")[2]
-    vocab, delay, pdelay, defaults = 97, 0.0, 0.0, {}
+    vocab, delay, pdelay, ddelay, agree = 97, 0.0, 0.0, 0.0, 1.0
+    defaults = {}
     for part in filter(None, body.split(",")):
         key, _, value = part.partition("=")
         key = key.strip()
@@ -194,12 +295,21 @@ def from_spec(spec):
             delay = float(value)
         elif key == "pdelay":
             pdelay = float(value)
+        elif key == "ddelay":
+            ddelay = float(value)
+        elif key == "agree":
+            agree = float(value)
+        elif key == "spec":
+            defaults["spec_depth"] = ("auto" if value.strip() == "auto"
+                                      else int(value))
         elif key in _GEOM_KEYS:
             defaults[_GEOM_KEYS[key]] = int(value)
         else:
             raise ValueError("unknown toydecode spec key %r (want "
-                             "vocab, delay, pdelay, %s)"
+                             "vocab, delay, pdelay, ddelay, agree, "
+                             "spec, %s)"
                              % (key, ", ".join(sorted(_GEOM_KEYS))))
     return ToyDecodeModel(vocab=vocab, step_delay=delay,
-                          prefill_delay=pdelay,
+                          prefill_delay=pdelay, draft_delay=ddelay,
+                          draft_agreement=agree,
                           decode_defaults=defaults)

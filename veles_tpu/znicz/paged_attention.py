@@ -46,7 +46,8 @@ from jax import lax
 
 __all__ = ["DEFAULT_BLOCK_SIZE", "paged_attention",
            "paged_attention_reference", "paged_prefill_attention",
-           "paged_prefill_attention_reference", "required_blocks"]
+           "paged_prefill_attention_reference", "paged_verify_attention",
+           "paged_verify_attention_reference", "required_blocks"]
 
 _NEG_INF = float("-inf")
 
@@ -226,6 +227,58 @@ def paged_prefill_attention_reference(q, k_pool, v_pool, block_row,
                                          q.shape[0])
     return paged_attention_reference(q, k_pool, v_pool, table, lens,
                                      scale=scale)
+
+
+def _verify_table_lengths(page_table, lengths, span):
+    """A speculative verify pass as a ragged "batch": the ``span`` query
+    tokens of every sequence (the fed token plus its draft tail) each
+    share the sequence's block row, and the per-query causal lengths are
+    ``length + i + 1`` — query ``i`` attends to the history plus the
+    ``i + 1`` tokens fed so far, never to the drafts after it.  Padding
+    rows (``length == 0``) stay padding at every span position."""
+    b, nb = page_table.shape
+    table = jnp.repeat(page_table.astype(jnp.int32), span, axis=0)
+    pos = jnp.arange(span, dtype=jnp.int32)[None, :]
+    lens = jnp.where(lengths[:, None] > 0,
+                     lengths[:, None].astype(jnp.int32) + pos + 1, 0)
+    return table, lens.reshape(b * span)
+
+
+def paged_verify_attention(q, k_pool, v_pool, page_table, lengths,
+                           scale=None):
+    """Multi-token (draft-and-verify) ragged paged attention.
+
+    ``q``: [B, S, H, D] — ``S`` query tokens per sequence (speculative
+    decoding's fed token + its ``S - 1`` draft tokens), whose K/V have
+    already been written at positions ``length .. length + S - 1``;
+    ``page_table``/``lengths``: as :func:`paged_attention` — ``lengths``
+    counts the cached tokens BEFORE this verify span.
+
+    Returns [B, S, H, D].  No new kernel (the same move as
+    :func:`paged_prefill_attention`): the span is flattened into the
+    batch axis of the decode kernel with per-query causal lengths
+    ``length + i + 1``, so one warm executable verifies any mix of
+    sequence lengths — the ragged batching of arXiv 2604.15464 serving
+    the verify pass natively.  Rejected draft positions are "rolled
+    back" simply by never advancing ``lengths`` past them: the kernel's
+    length masking makes their K/V writes invisible until overwritten.
+    """
+    b, s, h, d = q.shape
+    table, lens = _verify_table_lengths(page_table, lengths, s)
+    o = paged_attention(q.reshape(b * s, h, d), k_pool, v_pool,
+                        table, lens, scale=scale)
+    return o.reshape(b, s, h, d)
+
+
+def paged_verify_attention_reference(q, k_pool, v_pool, page_table,
+                                     lengths, scale=None):
+    """Dense oracle for :func:`paged_verify_attention` (same staging as
+    :func:`paged_attention_reference`, so parity stays bitwise)."""
+    b, s, h, d = q.shape
+    table, lens = _verify_table_lengths(page_table, lengths, s)
+    o = paged_attention_reference(q.reshape(b * s, h, d), k_pool,
+                                  v_pool, table, lens, scale=scale)
+    return o.reshape(b, s, h, d)
 
 
 def paged_attention_reference(q, k_pool, v_pool, page_table, lengths,

@@ -392,6 +392,68 @@ def decode_step(params, k_pools, v_pools, page_table, lengths, tokens,
             tuple(k_pools), tuple(v_pools))
 
 
+def _verify_block(p_i, h, k_pool_i, v_pool_i, page_table, lengths,
+                  blk, off, heads, k):
+    """One multi-token block of the speculative verify pass: write all
+    S fed tokens' K/V into their pool slots, then ragged verify
+    attention — per-position causal lengths keep query ``i`` blind to
+    the drafts after it (znicz.paged_attention.paged_verify_attention).
+    """
+    from ..paged_attention import paged_verify_attention
+    b, s, d = h.shape
+    hd = d // heads
+    qkv = _rmsnorm(h) @ p_i["qkv"]               # [B, S, 3d]
+    q, kk, vv = (qkv[..., i * d:(i + 1) * d].reshape(b, s, heads, hd)
+                 for i in range(3))
+    k_pool_i = k_pool_i.at[blk, off].set(kk)
+    v_pool_i = v_pool_i.at[blk, off].set(vv)
+    a = paged_verify_attention(q, k_pool_i, v_pool_i, page_table,
+                               lengths, scale=1.0 / math.sqrt(hd))
+    h = h + a.reshape(b, s, d) @ p_i["proj"]
+    moe = _moe_dense(p_i, _rmsnorm(h).reshape(b * s, d), k)
+    return h + moe.reshape(b, s, d), k_pool_i, v_pool_i
+
+
+def verify_step(params, k_pools, v_pools, page_table, lengths, tokens,
+                *, heads=2, block_size=8, k=1):
+    """Speculative verify: ``tokens`` [B, S] is each row's next input
+    plus its S-1 draft tokens.  Every position is written at
+    ``lengths[row] + i`` and attended with causal length
+    ``lengths[row] + i + 1``, so ``out[:, i]`` is the target's greedy
+    next token given the history plus fed tokens ``0 .. i`` — exactly
+    the token plain decode would emit at that step when the drafts
+    before it are all correct.  One executable per (B, S) — the ragged
+    kernel absorbs any mix of per-row lengths.
+
+    Writes past a row's page-table capacity scatter into the trash
+    block (only ever possible for draft positions past the row's
+    remaining token budget, whose outputs the scheduler discards).
+    The MoE stays the no-drop oracle over the flattened [B*S] tokens,
+    so rows remain isolated from each other AND positions from their
+    own rejected tails.
+    """
+    b, s = int(tokens.shape[0]), int(tokens.shape[1])
+    h = params["emb"][tokens]                    # [B, S, d]
+    stacked = _stacked(params)
+    stages = stacked["qkv"].shape[0]
+    nb = page_table.shape[1]
+    rows = jnp.arange(b)[:, None]
+    pos = lengths[:, None] + jnp.arange(s)[None, :]
+    blk = jnp.where(pos < nb * block_size,
+                    page_table[rows, jnp.minimum(pos // block_size,
+                                                 nb - 1)], 0)
+    off = pos % block_size
+    k_pools, v_pools = list(k_pools), list(v_pools)
+    for i in range(stages):
+        p_i = jax.tree.map(lambda p: p[i], stacked)
+        h, k_pools[i], v_pools[i] = _verify_block(
+            p_i, h, k_pools[i], v_pools[i], page_table, lengths, blk,
+            off, heads, k)
+    logits = h @ params["emb"].T                 # [B, S, V]
+    return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            tuple(k_pools), tuple(v_pools))
+
+
 def generate_reference(params, prompt, n_new, heads=2, k=1):
     """Cache-free greedy oracle: rerun the full dense causal forward
     over the whole history for every generated token.  O(T^2) per
@@ -436,6 +498,7 @@ class FlagshipDecodeModel:
             raise ValueError("d=%d not divisible by heads=%d"
                              % (self.d, self.heads))
         self.head_dim = self.d // self.heads
+        self._draft_table = None
 
     def make_pools(self, num_blocks, block_size):
         """Fresh zeroed per-layer K and V pools
@@ -478,6 +541,51 @@ class FlagshipDecodeModel:
 
         def fn(k_pools, v_pools, page_table, lengths, tokens):
             return decode_step(params, k_pools, v_pools, page_table,
+                               lengths, tokens, heads=heads,
+                               block_size=block_size, k=k)
+        return fn
+
+    def _unigram_table(self):
+        """The drafter: a [vocab] next-token table distilled from the
+        target by running it on every single-token prompt (a
+        context-free student of the teacher — the cheapest drafter
+        that still agrees with the target more often than chance).
+        Computed once, host-side, on first use."""
+        if self._draft_table is None:
+            h = self.params["emb"][jnp.arange(self.vocab)][:, None]
+            stacked = _stacked(self.params)
+            for i in range(self.layers):
+                p_i = jax.tree.map(lambda p: p[i], stacked)
+                h, _, _ = _prefill_block(p_i, h, self.heads, self.k)
+            logits = h[:, 0] @ self.params["emb"].T
+            self._draft_table = jnp.argmax(
+                logits, axis=-1).astype(jnp.int32)
+        return self._draft_table
+
+    def draft_fn(self, block_size, depth):
+        """(k_pools, v_pools, page_table, lengths, tokens[B]) ->
+        draft tokens [B, depth].  Pure reads — drafting never writes
+        the pools; acceptance is decided by the verify pass."""
+        table = self._unigram_table()
+        depth = int(depth)
+
+        def fn(k_pools, v_pools, page_table, lengths, tokens):
+            t = tokens
+            outs = []
+            for _ in range(depth):
+                t = table[t]
+                outs.append(t)
+            return jnp.stack(outs, axis=1)
+        return fn
+
+    def verify_fn(self, block_size, depth):
+        """(k_pools, v_pools, page_table, lengths, tokens[B, depth+1])
+        -> (out tokens [B, depth+1], pools) — the one-pass multi-token
+        verify the scheduler compiles once per speculation depth."""
+        params, heads, k = self.params, self.heads, self.k
+
+        def fn(k_pools, v_pools, page_table, lengths, tokens):
+            return verify_step(params, k_pools, v_pools, page_table,
                                lengths, tokens, heads=heads,
                                block_size=block_size, k=k)
         return fn
