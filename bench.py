@@ -960,6 +960,51 @@ def bench_fleet(replicas=3, probe_timeout=360):
     return {k: line.get(k) for k in keys}
 
 
+def bench_fleet_prefix(replicas=2, probe_timeout=400):
+    """Cache-aware routing vs least-loaded (ISSUE 16 acceptance:
+    affinity routing on the ``X-Veles-Prefix-Keys`` header beats
+    least-loaded dispatch on BOTH prefix-hit rate and TTFT p99 over a
+    multi-persona shared-prefix decode workload whose working set
+    exceeds one replica's HBM pool).  One fresh subprocess
+    (``tools/serve_bench.py --fleet-prefix N``) owns both fleets."""
+    import subprocess
+    import tempfile
+    _stamp("fleet-prefix stage")
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "serve_bench.py")
+    cache_dir = os.path.join(
+        tempfile.mkdtemp(prefix="veles-fprefix-bench-"), "compile_cache")
+    argv = [sys.executable, tool, "--fleet-prefix", str(replicas),
+            "--json", "--cache-dir", cache_dir]
+    proc = subprocess.run(argv, capture_output=True,
+                          timeout=probe_timeout)
+    line = _last_json_line(proc.stdout.decode())
+    if line is None:
+        raise RuntimeError("fleet-prefix probe failed: %s"
+                           % proc.stderr.decode()[-400:])
+    _stamp("fleet-prefix: hit rate %s vs %s, TTFT p99 %s ms vs %s ms "
+           "(%sx), failed=%s/%s mismatch=%s/%s"
+           % (line.get("fp_affinity_hit_rate"),
+              line.get("fp_baseline_hit_rate"),
+              line.get("fp_affinity_ttft_p99_ms"),
+              line.get("fp_baseline_ttft_p99_ms"),
+              line.get("fleet_prefix_ttft_p99_speedup"),
+              line.get("fp_affinity_failed"),
+              line.get("fp_baseline_failed"),
+              line.get("fp_affinity_mismatch"),
+              line.get("fp_baseline_mismatch")))
+    keys = ("fp_replicas", "fp_users", "fp_offered_rps", "fp_seconds",
+            "fp_num_blocks", "fp_baseline_ok", "fp_baseline_failed",
+            "fp_baseline_mismatch", "fp_baseline_hit_rate",
+            "fp_baseline_ttft_p50_ms", "fp_baseline_ttft_p99_ms",
+            "fp_affinity_ok", "fp_affinity_failed",
+            "fp_affinity_mismatch", "fp_affinity_hit_rate",
+            "fp_affinity_ttft_p50_ms", "fp_affinity_ttft_p99_ms",
+            "fp_affinity_affinity_hits", "fp_affinity_affinity_fallbacks",
+            "fleet_prefix_hit_rate_gain", "fleet_prefix_ttft_p99_speedup")
+    return {k: line.get(k) for k in keys}
+
+
 def bench_chaos(replicas=3, probe_timeout=400):
     """Seeded chaos drill on the real-package fleet (ISSUE 12
     acceptance: SIGKILL + black-hole + truncation + SIGSTOP under a
@@ -1530,6 +1575,8 @@ def _stage_main(stage):
         out = bench_speculative()
     elif stage == "fleet":
         out = bench_fleet()
+    elif stage == "fleet_prefix":
+        out = bench_fleet_prefix()
     elif stage == "chaos":
         out = bench_chaos()
     elif stage == "graph_compile":
@@ -1613,6 +1660,10 @@ STAGE_PLAN = [
     # and rolling-update error rate (ISSUE 7) — one fresh subprocess
     # owning router + N replica grandchildren under a hard cap
     ("fleet", 420),
+    # cache-aware routing vs least-loaded (ISSUE 16): two fresh fleets
+    # serving a shared-prefix persona workload — affinity must beat
+    # baseline on prefix-hit rate AND TTFT p99; one fresh subprocess
+    ("fleet_prefix", 420),
     # seeded chaos drill (ISSUE 12): scripted SIGKILL / black-hole /
     # truncation / SIGSTOP against the real-package fleet under a
     # deadline-carrying open loop — zero failed (non-backpressure,

@@ -98,6 +98,25 @@ def test_fault_plan_rejects_unknown_action():
         FaultPlan([{"at": 1, "action": "meteor"}])
 
 
+def test_fault_plan_path_prefix_narrows_rule():
+    """A path_prefix rule fires only on matching routes, and a
+    narrowed rule never shifts the global data-request ordinals the
+    other rules count against."""
+    plan = FaultPlan([
+        {"at": 2, "action": "refuse", "path_prefix": "/api/toy"},
+        {"at": 4, "action": "refuse"},
+    ])
+    paths = ["/api/toy/generate", "/api/other",   # ordinals 1, 2
+             "/api/toy/generate",                 # 3
+             "/api/other"]                        # 4
+    results = _fired(plan, paths)
+    refused = [i for i, (closed, _) in enumerate(results) if closed]
+    # ordinal 2 lands on /api/other — the narrowed rule stays quiet;
+    # the unnarrowed at=4 rule still fires on the 4th data request
+    assert refused == [3]
+    assert plan.fired == [(4, "refuse")]
+
+
 class _Sink:
     def __init__(self):
         self.data = b""
@@ -437,5 +456,84 @@ def test_deadline_expired_in_server_queue_returns_504():
             t.join(60)
         assert status == 504, (status, body)
         assert blockers.count(200) == 2, blockers
+    finally:
+        fleet.stop()
+
+
+# -- tiered KV cache under SIGKILL (ISSUE 16) ---------------------------------
+
+def test_sigkill_disk_tier_survives_respawn(tmp_path):
+    """The disk tier is the crash-durable rung: SIGKILL a replica whose
+    disk tier holds demoted chains and (a) requests carrying affinity
+    headers for its chains degrade to least-loaded on the peer with
+    ZERO failed responses during the down window, (b) the respawned
+    process re-opens the same per-rid tier directory and re-advertises
+    the surviving chains, and (c) the original prompt re-admitted from
+    disk generates the identical tokens — no re-prefill drift."""
+    from veles_tpu.kvtier import PREFIX_HEADER, prefix_key_header
+    spec = ("toydecode:vocab=64,block=4,max_batch=2,max_prompt=16,"
+            "max_new=8,num_blocks=8,prefix=1,chunk=8,tier_disk=1")
+    fleet = Fleet({"toy": spec}, replicas=2, poll_interval=0.1,
+                  request_timeout=5, kvtier_dir=str(tmp_path),
+                  backoff={"base": 0.1, "factor": 2.0, "cap": 2.0,
+                           "max_restarts": 10}).start(ready_timeout=120)
+    router = fleet.router
+    victim = "r0"
+    try:
+        _wait(lambda: router.ready_count() == 2, timeout=60,
+              what="both replicas ready")
+        desc = fleet.supervisor.describe()
+        victim_url = "http://%s:%d" % (fleet.supervisor.host,
+                                       desc[victim]["port"])
+        # populate the victim's tiers DIRECTLY (router-independent
+        # setup): enough distinct prompts that the 7-usable-block HBM
+        # pool evicts the earliest chains down to disk
+        prompt = [5, 6, 7, 8, 9, 10, 11, 12]
+        hdr = {PREFIX_HEADER: prefix_key_header(prompt, 4)}
+        status, warm, _ = _post(victim_url + "/api/toy/generate",
+                                {"prompt": prompt, "max_new_tokens": 6})
+        assert status == 200, (status, warm)
+        for i in range(4):
+            filler = [20 + 3 * i + j for j in range(8)]
+            s, _, _ = _post(victim_url + "/api/toy/generate",
+                            {"prompt": filler, "max_new_tokens": 6})
+            assert s == 200
+        key = hdr[PREFIX_HEADER].split(",")[0]
+        # the poll piggybacks the advertisement; wait until the router
+        # sees the warm chain on some non-HBM tier of the victim
+        _wait(lambda: router.fleet_kv(key)["replicas"]
+              .get(victim) in ("host", "disk"),
+              timeout=15, what="warm chain demoted and advertised")
+        pid = fleet.supervisor._replicas[victim].pid
+        os.kill(pid, signal.SIGKILL)
+        # down window: affinity for the victim's chains must degrade to
+        # the peer with zero raw failures (503 backpressure retried)
+        statuses = []
+        for _ in range(6):
+            st = -1
+            for _ in range(20):
+                st = _post(fleet.url + "/api/toy/generate",
+                           {"prompt": prompt, "max_new_tokens": 6},
+                           headers=hdr, timeout=30)[0]
+                if st != 503:
+                    break
+                time.sleep(0.1)
+            statuses.append(st)
+        assert statuses == [200] * 6, statuses
+        _wait(lambda: router.ready_count() == 2, timeout=60,
+              what="killed replica to respawn ready")
+        assert fleet.supervisor.describe()[victim]["restarts"] >= 1
+        # the respawned process re-opened the same per-rid disk dir and
+        # re-advertised its surviving chains before any traffic
+        _wait(lambda: router.fleet_kv(key)["replicas"]
+              .get(victim) == "disk",
+              timeout=15, what="disk chains re-advertised after respawn")
+        # zero re-prefill: the readmitted chain serves the original
+        # prompt with identical tokens
+        status, again, _ = _post(fleet.url + "/api/toy/generate",
+                                 {"prompt": prompt,
+                                  "max_new_tokens": 6}, headers=hdr)
+        assert status == 200
+        assert again["tokens"] == warm["tokens"], (warm, again)
     finally:
         fleet.stop()

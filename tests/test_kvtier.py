@@ -1,0 +1,434 @@
+"""Tiered KV cache: HBM -> host RAM -> disk (ISSUE 16).
+
+The contract under test (acceptance):
+- every tier round-trips a demoted block BITWISE: host hits, disk hits
+  (through the content-addressed chunk store) and the
+  ``pack_block``/``unpack_block`` wire all restore the exact bytes the
+  HBM pool evicted;
+- the disk tier is durable: a new :class:`DiskTier` over the same
+  directory (a respawned replica) lists the same chains and serves the
+  same bytes; identical payloads dedupe to ONE chunk no matter who
+  wrote them; a corrupted chunk makes its chains absent, not poisonous;
+- each tier evicts independently by byte capacity — the host tier's
+  LRU overflow cascades into disk, the disk tier drops stalest refs
+  then gc's orphaned chunks — and ``check_integrity`` proves the byte
+  accounting at every step;
+- the HBM pool's eviction hook fires only for refcount-0 chains:
+  eviction NEVER drops a chain a live session still references;
+- a chain evicted out of HBM re-admits from host or disk with zero
+  re-prefill: tokens stay bitwise equal to the cache-free oracle and
+  the readmitted TTFT drops the resident blocks' prefill cost;
+- concurrent admits against a tight pool while demotions fire resolve
+  safely (the worker serializes tier traffic): every response matches
+  its oracle and both pool and tiers stay integral;
+- all knobs default OFF == the prior scheduler exactly (no ``kvtier``
+  in stats, no ``kv_tiers`` in load) — MIGRATION.md's note enforced;
+- a warm restart over a populated disk tier compiles NOTHING and
+  advertises its disk chains before any traffic.
+"""
+
+import threading
+
+import numpy
+import pytest
+
+from veles_tpu.kvtier import (DiskTier, HostTier, PrefixDirectory,
+                              TieredKVStore, advert_key)
+from veles_tpu.serving import DecodeScheduler, KVBlockPool, ToyDecodeModel
+from veles_tpu.serving.kvcache import key_chain
+from veles_tpu.serving.sessions import pack_block, unpack_block
+
+GEOM = dict(max_batch=2, block_size=4, max_prompt_len=16,
+            max_new_tokens=8, num_blocks=8, prefix_caching=True,
+            prefill_chunk_tokens=8)
+
+
+@pytest.fixture(scope="module")
+def toy():
+    return ToyDecodeModel(vocab=31)
+
+
+@pytest.fixture(scope="module")
+def toy_oracle(toy):
+    memo = {}
+
+    def run(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in memo:
+            memo[key] = toy.generate_reference(prompt, n)
+        return memo[key]
+    return run
+
+
+def _payload(rng, scale=1):
+    return {"kv_k": numpy.asarray(rng.randint(0, 255, 16 * scale),
+                                  dtype=numpy.uint8),
+            "kv_v": rng.rand(8 * scale).astype(numpy.float32)}
+
+
+# -- wire ---------------------------------------------------------------------
+
+def test_pack_block_bitwise_and_canonical():
+    rng = numpy.random.RandomState(0)
+    payload = _payload(rng)
+    data = pack_block(payload)
+    back = unpack_block(data)
+    for name in payload:
+        assert back[name].dtype == payload[name].dtype
+        assert numpy.array_equal(back[name], payload[name])
+    # canonical: identical contents -> identical bytes (this is what
+    # makes the disk tier content-address across sessions/replicas)
+    clone = {k: v.copy() for k, v in payload.items()}
+    assert pack_block(clone) == data
+
+
+# -- host tier ----------------------------------------------------------------
+
+def test_host_tier_lru_touch_and_spill():
+    tier = HostTier(capacity_bytes=30)
+    assert tier.put("a", b"x" * 10) == []
+    assert tier.put("b", b"y" * 10) == []
+    assert tier.put("c", b"z" * 10) == []
+    assert tier.get("a") == b"x" * 10         # touch: 'a' newest now
+    spilled = tier.put("d", b"w" * 10)        # 'b' is oldest -> spills
+    assert spilled == [("b", b"y" * 10)]
+    assert tier.used_bytes == 30 and len(tier) == 3
+    assert tier.check_integrity() == []
+    # a sole block bigger than capacity spills itself (never wedges)
+    small = HostTier(capacity_bytes=4)
+    assert small.put("big", b"q" * 10) == [("big", b"q" * 10)]
+    assert len(small) == 0 and small.used_bytes == 0
+
+
+# -- disk tier ----------------------------------------------------------------
+
+def test_disk_tier_roundtrip_reopen_dedup(tmp_path):
+    d = str(tmp_path / "tier")
+    tier = DiskTier(d)
+    tier.put("aa11", b"payload-one")
+    tier.put("bb22", b"payload-two")
+    tier.put("cc33", b"payload-one")          # same bytes as aa11
+    assert tier.get("aa11") == b"payload-one"
+    assert sorted(tier.keys()) == ["aa11", "bb22", "cc33"]
+    # content addressing: two refs, ONE chunk for the shared payload
+    assert len(list(tier._chunks.digests())) == 2
+    assert tier.check_integrity() == []
+    # a fresh instance over the same directory (the respawn path) sees
+    # the same index and the same bytes
+    again = DiskTier(d)
+    assert sorted(again.keys()) == ["aa11", "bb22", "cc33"]
+    assert again.get("bb22") == b"payload-two"
+
+
+def test_disk_tier_corrupt_chunk_is_absent_not_poisonous(tmp_path):
+    from veles_tpu.checkpoint.store import digest_of
+    tier = DiskTier(str(tmp_path))
+    tier.put("aa11", b"precious")
+    digest = digest_of(b"precious")
+    with open(tier._chunks.path_for(digest), "wb") as f:
+        f.write(b"bitrot")
+    assert tier.get("aa11") is None           # absent, ref discarded
+    assert "aa11" not in tier
+    assert tier.check_integrity() == []
+
+
+def test_disk_tier_capacity_drops_stalest_then_gcs(tmp_path):
+    tier = DiskTier(str(tmp_path), capacity_bytes=25)
+    tier.put("k0", b"0" * 10)
+    tier.put("k1", b"1" * 10)
+    # third insert busts 25 bytes: k0 (stalest ref) goes, chunk gc'd
+    tier.put("k2", b"2" * 10)
+    assert "k0" not in tier
+    assert "k1" in tier and "k2" in tier
+    assert tier.used_bytes <= 25
+    assert tier.check_integrity() == []
+
+
+# -- tiered store -------------------------------------------------------------
+
+def test_tiered_store_requires_a_tier():
+    with pytest.raises(ValueError):
+        TieredKVStore()
+
+
+def test_tiered_store_roundtrip_cascade_promote(tmp_path):
+    rng = numpy.random.RandomState(7)
+    blocks = {("%02x" % i) * 8: pack_block(_payload(rng))
+              for i in range(6)}
+    nbytes = len(next(iter(blocks.values())))
+    store = TieredKVStore(host_bytes=2 * nbytes, disk_dir=str(tmp_path))
+    for key, data in blocks.items():
+        store.demote(key, data)
+    # host holds the 2 newest; the other 4 cascaded to disk
+    res = store.resident_keys()
+    assert len(res["host"]) == 2 and len(res["disk"]) == 4
+    assert store.demotions["host"] == 6 and store.demotions["disk"] == 4
+    assert store.check_integrity() == []
+    # every chain still round-trips bitwise, whatever tier it is on
+    for key, data in blocks.items():
+        tier, got = store.lookup(key)
+        assert got == data, key
+    # a disk hit touch-promotes: the chain is copied up into host RAM
+    disk_key = next(k for k in blocks if store.tier_of(k) == "disk"
+                    or k in res["disk"])
+    before = store.disk_readmits
+    tier, got = store.lookup(disk_key)
+    if tier == "disk":                        # (may have promoted above)
+        assert store.tier_of(disk_key) == "host"
+        assert store.disk_readmits == before + 1
+    assert store.check_integrity() == []
+    # version bumps on mutation: advertisement rebuilds are gated on it
+    v = store.version
+    store.demote("ff" * 8, pack_block(_payload(rng)))
+    assert store.version > v
+
+
+def test_tiered_store_observer_is_duck_typed(tmp_path):
+    calls = []
+
+    class Obs:
+        def record_tier_demotion(self, tier, nbytes):
+            calls.append(("demote", tier))
+
+        def record_disk_readmit(self):
+            calls.append(("readmit", "disk"))
+        # record_tier_promotion intentionally absent
+
+    store = TieredKVStore(disk_dir=str(tmp_path), observer=Obs())
+    store.demote(b"\x01" * 32, b"data")
+    assert store.lookup(b"\x01" * 32) == ("disk", b"data")
+    assert ("demote", "disk") in calls and ("readmit", "disk") in calls
+
+
+# -- eviction hook safety -----------------------------------------------------
+
+def test_pool_on_evict_fires_only_for_unreferenced_chains():
+    """The demotion hook sees exactly the refcount-0 LRU evictions the
+    pool was already doing — a refcounted chain can NEVER reach it."""
+    pool = KVBlockPool(num_blocks=7, block_size=4, prefix_caching=True)
+    evicted = []
+    pool.on_evict = lambda block, key: evicted.append((block, key))
+    keep = pool.alloc(2)
+    for i, b in enumerate(keep):
+        pool.publish(b, b"keep%d" % i)        # refcount 1: pinned
+    park = pool.alloc(3)
+    for i, b in enumerate(park):
+        pool.publish(b, b"park%d" % i)
+    pool.release(park)                        # refcount 0: evictable
+    assert pool.alloc(3) is not None          # pressure: evicts parked
+    assert len(evicted) == 2                  # 1 free + 3 cached, need 3
+    assert {k for _, k in evicted} <= {b"park0", b"park1", b"park2"}
+    # the referenced chains survived the pressure
+    assert len(pool.acquire_prefix([b"keep0", b"keep1"])) == 2
+    assert pool.check_integrity() == []
+
+
+# -- prefix directory ---------------------------------------------------------
+
+def test_prefix_directory_longest_run_ties_and_residency():
+    d = PrefixDirectory()
+    d.update("r1", {"hbm": ["aa"], "disk": ["bb"]})
+    d.update("r0", {"host": ["aa", "bb"], "disk": ["cc"]})
+    # longest consecutive LEADING run wins: r0 holds aa,bb,cc
+    assert d.best_replica(["aa", "bb", "cc", "dd"]) == ("r0", 3)
+    # a gap stops the run even if later keys are resident
+    assert d.best_replica(["zz", "aa"]) == (None, 0)
+    # candidates restrict the search to eligible replicas
+    assert d.best_replica(["aa", "bb"], candidates={"r1"}) == ("r1", 2)
+    # fastest tier wins per key; residency reports per-replica tiers
+    d.update("r1", {"hbm": ["aa"], "disk": ["aa", "bb"]})
+    assert d.residency("aa") == {"r0": "host", "r1": "hbm"}
+    snap = d.snapshot()
+    assert snap["r1"]["hbm"] == ["aa"] and snap["r1"]["disk"] == ["bb"]
+    d.drop("r0")
+    assert d.replicas() == ["r1"]
+    # ties break on the smaller rid for determinism
+    ties = PrefixDirectory()
+    ties.update("rB", {"hbm": ["aa"]})
+    ties.update("rA", {"hbm": ["aa"]})
+    assert ties.best_replica(["aa"]) == ("rA", 1)
+
+
+def test_advert_key_truncates_hex():
+    assert advert_key(b"\xab" * 32) == "ab" * 8
+    assert advert_key("ff00" * 20) == ("ff00" * 4)
+
+
+# -- scheduler: demote / readmit bitwise --------------------------------------
+
+def _churn(s, toy_oracle, n=4, base=40):
+    """Push n distinct 8-token prompts through to force HBM eviction."""
+    for i in range(n):
+        filler = [(base + 5 * i + j) % 31 for j in range(8)]
+        assert s.generate(filler, 4, timeout=60)["tokens"] == \
+            toy_oracle(filler, 4)
+
+
+def test_host_tier_readmit_bitwise(toy, toy_oracle):
+    s = DecodeScheduler(toy, name="kvhost", **GEOM,
+                        kvtier={"host_bytes": 1 << 20})
+    try:
+        prompt = [5, 6, 7, 8, 9, 10, 11, 12, 13]      # two full blocks
+        cold = s.generate(prompt, 6, timeout=60)
+        assert cold["tokens"] == toy_oracle(prompt, 6)
+        _churn(s, toy_oracle)
+        kstats = s.stats()["kvtier"]
+        assert kstats["demotions"]["host"] > 0
+        warm = s.generate(prompt, 6, timeout=60)
+        assert warm["tokens"] == cold["tokens"]
+        kstats = s.stats()["kvtier"]
+        assert kstats["promotions"]["host"] >= 2      # both lead blocks
+        assert s.stats()["post_warmup_compiles"] == 0
+    finally:
+        s.close(drain=True)
+
+
+def test_disk_tier_readmit_bitwise_zero_reprefill(tmp_path, toy_oracle):
+    """A chain evicted from HBM with ONLY a disk tier below re-admits
+    from disk: identical tokens, ``disk_readmits`` counted, and the
+    readmitted TTFT is missing the resident blocks' prefill cost (the
+    per-token prefill delay pins that cost, so this is deterministic
+    ordering, not a benchmark)."""
+    model = ToyDecodeModel(vocab=31, prefill_delay=0.004)
+    oracle = model.generate_reference
+    s = DecodeScheduler(model, name="kvdisk", **GEOM,
+                        kvtier={"disk_dir": str(tmp_path)})
+    try:
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9]  # 3 full blocks
+        cold = s.generate(prompt, 6, timeout=60)
+        assert cold["tokens"] == oracle(prompt, 6)
+        for i in range(4):
+            filler = [(7 + 3 * i + j) % 31 for j in range(8)]
+            assert s.generate(filler, 4, timeout=60)["tokens"] == \
+                oracle(filler, 4)
+        kstats = s.stats()["kvtier"]
+        assert kstats["demotions"]["disk"] > 0
+        assert kstats["disk_blocks"] > 0
+        warm = s.generate(prompt, 6, timeout=60)
+        assert warm["tokens"] == cold["tokens"] == oracle(prompt, 6)
+        kstats = s.stats()["kvtier"]
+        assert kstats["disk_readmits"] >= 3           # the 3 lead blocks
+        # 12 of 13 prompt tokens were resident: their pinned prefill
+        # delay is absent from the readmitted TTFT
+        assert warm["ttft_s"] < cold["ttft_s"] * 0.6, (cold["ttft_s"],
+                                                       warm["ttft_s"])
+        assert s._kvtier.check_integrity() == []
+    finally:
+        s.close(drain=True)
+
+
+def test_concurrent_admits_while_demoting(toy, toy_oracle):
+    """Submissions racing each other over a tight pool with the tier
+    stack wired: the worker serializes admit/demote/readmit, so every
+    response is bitwise its oracle and every invariant holds after."""
+    s = DecodeScheduler(toy, name="kvrace", **GEOM,
+                        kvtier={"host_bytes": 1 << 20})
+    rng = numpy.random.RandomState(5)
+    universe = [rng.randint(0, 31, 9).tolist() for _ in range(6)]
+    failures = []
+
+    def client(seed):
+        r = numpy.random.RandomState(seed)
+        for _ in range(6):
+            prompt = universe[r.randint(len(universe))]
+            try:
+                out = s.generate(prompt, 4, timeout=60)
+                if out["tokens"] != toy_oracle(prompt, 4):
+                    failures.append((prompt, out["tokens"]))
+            except Exception as e:        # noqa: BLE001 - collected
+                failures.append((prompt, repr(e)))
+    try:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert failures == []
+        assert s._pool.check_integrity() == []
+        assert s._kvtier.check_integrity() == []
+        assert s.stats()["kvtier"]["demotions"]["host"] > 0
+    finally:
+        s.close(drain=True)
+
+
+# -- knobs default off (MIGRATION.md, enforced) -------------------------------
+
+def test_kvtier_default_off_is_prior_behavior(toy, toy_oracle):
+    s = DecodeScheduler(toy, name="kvoff", **GEOM)
+    try:
+        prompt = [9, 8, 7, 6, 5, 4, 3, 2, 1]
+        assert s.generate(prompt, 6, timeout=60)["tokens"] == \
+            toy_oracle(prompt, 6)
+        assert "kvtier" not in s.stats()
+        assert "kv_tiers" not in s.load()
+        assert s._pool.on_evict is None
+    finally:
+        s.close(drain=True)
+    # the tier stack rides the prefix machinery; without it, refuse
+    with pytest.raises(ValueError, match="prefix"):
+        DecodeScheduler(toy, name="kvbad", max_batch=2, block_size=4,
+                        max_prompt_len=16, max_new_tokens=8,
+                        num_blocks=8, warmup=False,
+                        kvtier={"host_bytes": 1 << 20})
+
+
+# -- advertisement ------------------------------------------------------------
+
+def test_load_advertises_resident_tiers(toy, toy_oracle):
+    s = DecodeScheduler(toy, name="kvadv", **GEOM,
+                        kvtier={"host_bytes": 1 << 20})
+    try:
+        prompt = [11, 12, 13, 14, 15, 16, 17, 18, 19]
+        s.generate(prompt, 6, timeout=60)
+        adv = s.load()["kv_tiers"]
+        expect = {advert_key(k) for k in key_chain(prompt, 4)}
+        assert expect <= set(adv["hbm"])      # resident in HBM post-run
+        _churn(s, toy_oracle)
+        adv = s.load()["kv_tiers"]
+        assert expect & set(adv["host"])      # demoted chains re-advertise
+    finally:
+        s.close(drain=True)
+
+
+# -- warm restart -------------------------------------------------------------
+
+def test_warm_restart_disk_tier_compiles_nothing(tmp_path, toy_oracle):
+    """Restarting over a populated disk tier + warm compile cache: the
+    new scheduler advertises its disk chains BEFORE any traffic,
+    compiles nothing, and serves the old chain from disk bitwise."""
+    from veles_tpu.compilecache import (default_cache,
+                                        reset_default_caches)
+    from veles_tpu.config import root
+    model = ToyDecodeModel(vocab=31)
+    prior = root.common.compile_cache.get("dir", None)
+    root.common.compile_cache.dir = str(tmp_path / "cache")
+    reset_default_caches()
+    tier_dir = str(tmp_path / "tier")
+    kw = dict(GEOM, kvtier={"disk_dir": tier_dir})
+    try:
+        prompt = [2, 7, 1, 8, 2, 8, 1, 8, 2, 8, 4, 5, 9]
+        s1 = DecodeScheduler(model, name="kvwarm", **kw)
+        cold = s1.generate(prompt, 6, timeout=60)
+        for i in range(4):
+            filler = [(3 + 5 * i + j) % 31 for j in range(8)]
+            s1.generate(filler, 4, timeout=60)
+        assert s1.stats()["kvtier"]["disk_blocks"] > 0
+        s1.close(drain=True)
+        s2 = DecodeScheduler(model, name="kvwarm", **kw)
+        warm_stats = s2.stats()
+        assert warm_stats["compiles"] == 0
+        assert warm_stats["cache_hits"] == warm_stats["executables"]
+        # the previous incarnation's disk chains advertise pre-traffic
+        adv = s2.load()["kv_tiers"]
+        expect = {advert_key(k) for k in key_chain(prompt, 4)}
+        assert expect <= set(adv["disk"]), adv
+        again = s2.generate(prompt, 6, timeout=60)
+        assert again["tokens"] == cold["tokens"] == \
+            model.generate_reference(prompt, 6)
+        assert s2.stats()["kvtier"]["disk_readmits"] >= 3
+        assert s2.stats()["compiles"] == 0    # still nothing compiled
+        s2.close(drain=True)
+    finally:
+        root.common.compile_cache.dir = prior
+        reset_default_caches()

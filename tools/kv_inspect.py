@@ -6,6 +6,8 @@ Usage::
     python tools/kv_inspect.py http://HOST:PORT --model NAME   # one model
     python tools/kv_inspect.py ... --verify                    # exit 1 on violations
     python tools/kv_inspect.py ... --json                      # machine output
+    python tools/kv_inspect.py http://ROUTER --fleet           # tier directory
+    python tools/kv_inspect.py http://ROUTER --fleet --key HEX # one chain key
 
 The decode-serving sibling of ``tools/ckpt_inspect.py``: where that tool
 re-hashes checkpoint chunks on disk, this one reads the scheduler's
@@ -16,6 +18,12 @@ domains, no session referencing an unallocated block).  ``--verify``
 turns any violation into exit code 1, which is how the chaos drill
 (tools/serve_bench.py --chaos) asserts pool integrity on every replica
 after a fault run.
+
+``--fleet`` points the tool at a fleet ROUTER instead of one replica
+and reads its aggregated ``GET /fleet/kv`` route: the advertised tier
+directory (which replica holds which chain keys, in HBM / host RAM /
+on disk) plus the cache-aware-routing counters; ``--key HEX`` narrows
+to one chain key's residency per replica (hbm/host/disk/absent).
 """
 
 import argparse
@@ -45,6 +53,38 @@ def fetch_dump(base_url, model, timeout=10.0):
 def verify_dump(dump):
     """Violation list for one kv_dump document (empty == healthy)."""
     return list(dump.get("integrity", ()))
+
+
+def fetch_fleet_kv(base_url, key=None, timeout=10.0):
+    url = base_url.rstrip("/") + "/fleet/kv"
+    if key:
+        url += "?key=" + key
+    return fetch_json(url, timeout)
+
+
+def describe_fleet(doc):
+    """Render the router's tier directory / one key's residency."""
+    lines = []
+    if "key" in doc:                          # --key: residency of one
+        lines.append("chain %s:" % doc["key"])
+        for rid in sorted(doc["replicas"]):
+            lines.append("  %-8s %s" % (rid, doc["replicas"][rid]))
+        return "\n".join(lines)
+    lines.append("fleet tier directory (%d replica(s); affinity "
+                 "%d hit(s) / %d fallback(s)):"
+                 % (len(doc["replicas"]), doc.get("affinity_hits", 0),
+                    doc.get("affinity_fallbacks", 0)))
+    for rid in sorted(doc["replicas"]):
+        tiers = doc["replicas"][rid]
+        lines.append("  %s: %d advertised chain(s)"
+                     % (rid, tiers.get("total", 0)))
+        for tier in ("hbm", "host", "disk"):
+            keys = tiers.get(tier) or []
+            if keys:
+                lines.append("    %-4s %3d  %s%s"
+                             % (tier, len(keys), " ".join(keys[:8]),
+                                " ..." if len(keys) > 8 else ""))
+    return "\n".join(lines)
 
 
 def describe(dump):
@@ -79,6 +119,15 @@ def describe(dump):
                "%.2f" % spec["acceptance_rate"]
                if spec.get("acceptance_rate") is not None else "-",
                spec["draft_rollbacks"], spec["rolled_back_tokens"]))
+    kvt = dump.get("kvtier")
+    if kvt:
+        lines.append(
+            "  tiers: host %d block(s) / %d B, disk %d block(s) / %d B;"
+            " %d demotion(s) host / %d disk, %d disk readmit(s)"
+            % (kvt.get("host_blocks", 0), kvt.get("host_bytes", 0),
+               kvt.get("disk_blocks", 0), kvt.get("disk_bytes", 0),
+               kvt["demotions"]["host"], kvt["demotions"]["disk"],
+               kvt.get("disk_readmits", 0)))
     for entry in dump["shared"]:
         lines.append("  shared  block %4d  key %s  refcount %d"
                      % (entry["block"], entry["key"],
@@ -108,8 +157,26 @@ def main(argv=None):
                     help="exit 1 if any pool invariant is violated")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON document instead of text")
+    ap.add_argument("--fleet", action="store_true",
+                    help="URL is a fleet router: read its aggregated "
+                         "/fleet/kv tier directory instead of a pool")
+    ap.add_argument("--key", help="with --fleet: one chain key "
+                                  "(truncated hex) to locate fleet-wide")
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        doc = fetch_fleet_kv(args.url, args.key, args.timeout)
+        if args.json:
+            print(json.dumps(doc, indent=1, sort_keys=True))
+        else:
+            print(describe_fleet(doc))
+        if args.key and not any(
+                t != "absent" for t in doc["replicas"].values()):
+            return 1                          # resident nowhere
+        return 0
+    if args.key:
+        ap.error("--key requires --fleet")
 
     names = [args.model] if args.model else \
         decode_models(args.url, args.timeout)

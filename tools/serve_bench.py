@@ -899,6 +899,142 @@ def run_fleet_bench(replicas=3, clients=None, seconds=2.0,
     return out
 
 
+def run_fleet_prefix_bench(replicas=2, users=None, seconds=5.0,
+                           offered_rps=30.0, num_blocks=40,
+                           cache_dir=None):
+    """Cache-aware routing vs least-loaded on a multi-replica
+    shared-prefix decode workload (ISSUE 16 acceptance).
+
+    ``users`` personas each own a distinct system prefix; requests
+    arrive open-loop, round-robin across personas.  The HBM pool is
+    sized so ONE replica cannot hold every persona's chains: least-
+    loaded routing duplicates the working set on every replica and
+    thrashes, while cache-aware routing (the ``X-Veles-Prefix-Keys``
+    header against the router's prefix directory) partitions personas
+    across replicas so each set fits.  Both phases run a FRESH fleet
+    over the same compile cache; the bar is affinity beating baseline
+    on BOTH the prefix-hit rate and TTFT p99."""
+    import shutil
+    from veles_tpu.fleet import Fleet
+    from veles_tpu.kvtier import PREFIX_HEADER, prefix_key_header
+    from veles_tpu.serving.toydecode import ToyDecodeModel
+
+    users = users or 12 * replicas
+    block = 4
+    spec = ("toydecode:vocab=97,pdelay=0.002,max_batch=4,block=%d,"
+            "max_prompt=16,max_new=8,chunk=8,prefix=1,num_blocks=%d,"
+            "tier_host=%d" % (block, num_blocks, 32 << 20))
+    tmp = None
+    if cache_dir is None:
+        tmp = tempfile.mkdtemp(prefix="fleet_prefix_")
+        cache_dir = os.path.join(tmp, "compile_cache")
+    # distinct 8-token system prefixes (2 full blocks each)
+    prefixes = [[(7 * u + j) % 97 for j in range(8)]
+                for u in range(users)]
+    prefix_headers = [prefix_key_header(p, block) for p in prefixes]
+    oracle_model = ToyDecodeModel(vocab=97)
+    oracle_memo = {}
+
+    def oracle(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in oracle_memo:
+            oracle_memo[key] = oracle_model.generate_reference(prompt, n)
+        return oracle_memo[key]
+
+    def phase(with_header):
+        fleet = Fleet({"kv": spec}, replicas=replicas,
+                      cache_dir=cache_dir, poll_interval=0.1,
+                      backoff={"base": 0.2, "factor": 2.0, "cap": 5.0,
+                               "max_restarts": 10})
+        fleet.start(ready_timeout=300)
+        res = {"ok": 0, "shed": 0, "failed": 0, "mismatch": 0,
+               "ttfts": []}
+        lock = threading.Lock()
+
+        def fire(k):
+            u = k % users
+            prompt = prefixes[u] + [10 + (k // users) % 5]
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", fleet.port, timeout=30)
+                headers = {"Content-Type": "application/json"}
+                if with_header:
+                    headers[PREFIX_HEADER] = prefix_headers[u]
+                conn.request("POST", "/api/kv/generate",
+                             json.dumps({"prompt": prompt,
+                                         "max_new_tokens": 6}).encode(),
+                             headers)
+                resp = conn.getresponse()
+                body = json.loads(resp.read() or b"{}")
+                status = resp.status
+                conn.close()
+            except Exception:
+                status, body = -1, {}
+            with lock:
+                if status == 200:
+                    if body.get("tokens") == oracle(prompt, 6):
+                        res["ok"] += 1
+                        res["ttfts"].append(body.get("ttft_s", 0.0))
+                    else:
+                        res["mismatch"] += 1
+                elif status in (429, 503):
+                    res["shed"] += 1
+                else:
+                    res["failed"] += 1
+
+        threads = []
+        start = time.perf_counter()
+        for k in range(max(1, int(offered_rps * seconds))):
+            due = start + k / offered_rps
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=fire, args=(k,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        met = fleet.router.merged_metrics()
+        res["prefix_hits"] = sum(
+            (rep or {}).get("kv", {}).get("prefix_hits", 0)
+            for rep in met["replicas"].values())
+        res["affinity_hits"] = met["router"]["affinity_hits"]
+        res["affinity_fallbacks"] = met["router"]["affinity_fallbacks"]
+        fleet.stop()
+        return res
+
+    out = {"fp_replicas": replicas, "fp_users": users,
+           "fp_offered_rps": offered_rps, "fp_seconds": seconds,
+           "fp_num_blocks": num_blocks}
+    try:
+        for mode, res in (("baseline", phase(False)),
+                          ("affinity", phase(True))):
+            q = _quantiles_ms(res["ttfts"])
+            served = max(res["ok"], 1)
+            out["fp_%s_ok" % mode] = res["ok"]
+            out["fp_%s_shed" % mode] = res["shed"]
+            out["fp_%s_failed" % mode] = res["failed"]
+            out["fp_%s_mismatch" % mode] = res["mismatch"]
+            out["fp_%s_prefix_hits" % mode] = res["prefix_hits"]
+            out["fp_%s_hit_rate" % mode] = round(
+                res["prefix_hits"] / served, 4)
+            out["fp_%s_ttft_p50_ms" % mode] = q.get("p50_ms")
+            out["fp_%s_ttft_p99_ms" % mode] = q.get("p99_ms")
+            out["fp_%s_affinity_hits" % mode] = res["affinity_hits"]
+            out["fp_%s_affinity_fallbacks" % mode] = \
+                res["affinity_fallbacks"]
+    finally:
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+    base_p99 = out.get("fp_baseline_ttft_p99_ms")
+    aff_p99 = out.get("fp_affinity_ttft_p99_ms")
+    out["fleet_prefix_hit_rate_gain"] = round(
+        out["fp_affinity_hit_rate"] - out["fp_baseline_hit_rate"], 4)
+    out["fleet_prefix_ttft_p99_speedup"] = round(
+        base_p99 / aff_p99, 2) if base_p99 and aff_p99 else None
+    return out
+
+
 def _post_json(port, route, payload, timeout=30):
     """One JSON POST to the local router; → (status, parsed body)."""
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
@@ -1163,6 +1299,13 @@ def main(argv=None):
                         "open-loop load")
     p.add_argument("--drill-seconds", type=float, default=4.0,
                    help="open-loop window for each fleet drill")
+    p.add_argument("--fleet-prefix", type=int, default=None,
+                   metavar="N",
+                   help="cache-aware-routing mode: N replicas serving "
+                        "a multi-persona shared-prefix decode workload "
+                        "twice — least-loaded vs X-Veles-Prefix-Keys "
+                        "affinity — comparing prefix-hit rate and "
+                        "TTFT p99")
     p.add_argument("--chaos", type=int, default=None, metavar="N",
                    help="chaos drill mode: N replicas with scripted "
                         "fault plans (SIGKILL, truncation, black-hole, "
@@ -1202,6 +1345,35 @@ def main(argv=None):
                      out.get("chaos_kv_dedup_blocks"),
                      out.get("chaos_kv_violations") or "none"),
                   file=sys.stderr)
+        print(json.dumps(line))
+        return 0
+
+    if args.fleet_prefix:
+        out = run_fleet_prefix_bench(
+            replicas=args.fleet_prefix,
+            seconds=args.seconds if args.seconds != 2.0 else 5.0,
+            offered_rps=args.offered_rps or 30.0,
+            cache_dir=args.cache_dir)
+        line = {"metric": "fleet_prefix_ttft_p99_speedup",
+                "value": out.get("fleet_prefix_ttft_p99_speedup"),
+                "unit": "x"}
+        line.update(out)
+        if not args.json:
+            print("fleet prefix bench: hit rate %s (affinity) vs %s "
+                  "(least-loaded), TTFT p99 %s ms vs %s ms (%sx); "
+                  "affinity hits=%s fallbacks=%s; failed=%s/%s "
+                  "mismatch=%s/%s"
+                  % (out.get("fp_affinity_hit_rate"),
+                     out.get("fp_baseline_hit_rate"),
+                     out.get("fp_affinity_ttft_p99_ms"),
+                     out.get("fp_baseline_ttft_p99_ms"),
+                     out.get("fleet_prefix_ttft_p99_speedup"),
+                     out.get("fp_affinity_affinity_hits"),
+                     out.get("fp_affinity_affinity_fallbacks"),
+                     out.get("fp_affinity_failed"),
+                     out.get("fp_baseline_failed"),
+                     out.get("fp_affinity_mismatch"),
+                     out.get("fp_baseline_mismatch")), file=sys.stderr)
         print(json.dumps(line))
         return 0
 

@@ -18,7 +18,13 @@ the data plane burns).  A rule is a dict::
      "after": 5,       #   on every data request from the 5th on, or
      "every": 7,       #   on every 7th, or
      "probability": p, #   i.i.d. with the plan's seeded RNG
+     "path_prefix": "/api/toy",  # optional: only on matching paths
      "action": ...}    # what happens (below)
+
+A ``path_prefix`` narrows a rule to one route (e.g. SIGKILL on the
+Nth *generate* call specifically, leaving other data traffic alone);
+the ordinal ``n`` still counts every data request, so adding a
+narrowed rule never shifts when the other rules fire.
 
 Actions:
 
@@ -167,7 +173,8 @@ class FaultPlan:
         with self._lock:
             self._count += 1
             n = self._count
-            hits = [r for r in self.rules if self._matches(r, n)]
+            hits = [r for r in self.rules if self._matches(r, n)
+                    and path.startswith(r.get("path_prefix", "/api"))]
             for rule in hits:
                 self.fired.append((n, rule["action"]))
         return n, hits

@@ -83,11 +83,18 @@ def main(argv=None):
     p.add_argument("--cache-dir", default=None,
                    help="persistent executable cache dir (usually "
                         "inherited via VELES_COMPILE_CACHE_DIR)")
+    p.add_argument("--kvtier-dir", default=None,
+                   help="disk tier directory for the tiered KV cache "
+                        "(usually inherited via VELES_KVTIER_DIR)")
     args = p.parse_args(argv)
 
     from ..config import root
     if args.cache_dir:
         root.common.compile_cache.dir = args.cache_dir
+    if args.kvtier_dir:
+        # resolved by DecodeScheduler's kvtier disk_dir=True path
+        from ..kvtier import DIR_ENV
+        os.environ[DIR_ENV] = args.kvtier_dir
     from ..observability import trace as _trace
     _trace.adopt_env()
 

@@ -59,9 +59,11 @@ import json
 import socket
 import threading
 import time
+import urllib.parse
 from http.server import ThreadingHTTPServer
 
 from ..httpjson import JsonRequestHandler
+from ..kvtier import PREFIX_HEADER, PrefixDirectory
 from ..logger import events
 from ..observability import trace as _trace
 from ..observability.registry import REGISTRY
@@ -184,6 +186,11 @@ class _RouterHandler(JsonRequestHandler):
             self.send_json(200, router.merged_models())
         elif path == "/metrics":
             self.send_json(200, router.merged_metrics())
+        elif path == "/fleet/kv":
+            query = urllib.parse.parse_qs(
+                urllib.parse.urlparse(self.path).query)
+            key = (query.get("key") or [None])[0]
+            self.send_json(200, router.fleet_kv(key))
         else:
             self.send_json(404, {"error": "not found"})
 
@@ -260,6 +267,19 @@ class FleetRouter:
         self._c_follow = registry.counter(
             "veles_fleet_session_follows_total",
             "307 migration redirects followed to a session's new home")
+        # fleet-wide prefix directory (veles_tpu/kvtier): replicas
+        # advertise resident chain keys in their /readyz load payload;
+        # requests carrying X-Veles-Prefix-Keys are steered to the
+        # replica holding the longest resident run of them
+        self.prefix_directory = PrefixDirectory()
+        self._c_aff_hit = registry.counter(
+            "veles_fleet_affinity_hits_total",
+            "Requests routed to the replica holding the longest "
+            "resident prefix of their prompt chain")
+        self._c_aff_fallback = registry.counter(
+            "veles_fleet_affinity_fallbacks_total",
+            "Requests that carried prefix keys but fell back to "
+            "least-loaded (no eligible replica held any of them)")
         handler = type("Handler", (_RouterHandler,),
                        {"server_ref": self,
                         "timeout": max(self.request_timeout, 1.0)})
@@ -304,6 +324,7 @@ class FleetRouter:
         if rep is not None:
             self._g_up.labels(replica=rid).set(0)
             self._g_ready.labels(replica=rid).set(0)
+            self.prefix_directory.drop(rid)
         return rep is not None
 
     def replica_ids(self):
@@ -433,6 +454,18 @@ class FleetRouter:
                 isinstance(body, dict) and body.get("ready"))
             if isinstance(body, dict):
                 rep.load = body.get("load") or {}
+                # resident-chain advertisement piggybacked on the load
+                # poll: merge every model's kv_tiers into the fleet
+                # prefix directory (an answer without any clears stale
+                # entries — the replica restarted tierless)
+                tiers = {}
+                for model_load in rep.load.values():
+                    adv = (model_load or {}).get("kv_tiers")
+                    if not isinstance(adv, dict):
+                        continue
+                    for tier, keys in adv.items():
+                        tiers.setdefault(tier, []).extend(keys or ())
+                self.prefix_directory.update(rep.id, tiers)
             self._breaker_probe(rep)
         self._g_up.labels(replica=rep.id).set(int(rep.up))
         self._g_ready.labels(replica=rep.id).set(int(rep.ready))
@@ -580,6 +613,32 @@ class FleetRouter:
             return None
         return time.monotonic() + max(ms, 0.0) / 1e3
 
+    def _affinity_pick(self, handler):
+        """Cache-aware routing: map the request's X-Veles-Prefix-Keys
+        (the prompt's chain keys, leading blocks first) to the replica
+        holding the longest resident run of them.  Only *biases* the
+        first dispatch leg among currently-eligible replicas — unlike
+        session affinity it respects the admitting flag, and a holder
+        that is down/draining degrades to least-loaded (counted as a
+        fallback, never a failure)."""
+        raw = handler.headers.get(PREFIX_HEADER)
+        if not raw:
+            return None
+        keys = [k.strip() for k in raw.split(",") if k.strip()]
+        if not keys:
+            return None
+        with self._lock:
+            eligible = {r.id for r in self._replicas.values()
+                        if r.up and r.ready and r.admitting
+                        and r.breaker == "closed"}
+        rid, matched = self.prefix_directory.best_replica(
+            keys, candidates=eligible)
+        if rid is not None and matched:
+            self._c_aff_hit.inc()
+            return rid
+        self._c_aff_fallback.inc()
+        return None
+
     def _retry_budget(self):
         """Connection-level legs allowed per request: one per known
         replica (min 2).  Retrying is always safe here — a leg that
@@ -603,6 +662,8 @@ class FleetRouter:
         follows = 0
         attach = False
         prefer = self._session_home(sid) if sid else None
+        if prefer is None:
+            prefer = self._affinity_pick(handler)
         rep = None
         while True:
             if deadline is not None:
@@ -742,6 +803,20 @@ class FleetRouter:
                     "ready": desc.get("ready")}
         return out
 
+    def fleet_kv(self, key=None):
+        """The ``GET /fleet/kv`` payload: with ``key=``, that chain
+        key's tier residency per replica (hbm / host / disk / absent);
+        without, the whole advertised directory plus the affinity
+        counters — tools/kv_inspect.py --fleet renders both."""
+        if key:
+            residency = self.prefix_directory.residency(str(key))
+            return {"key": str(key),
+                    "replicas": {rid: residency.get(rid, "absent")
+                                 for rid in self.replica_ids()}}
+        return {"replicas": self.prefix_directory.snapshot(max_keys=64),
+                "affinity_hits": int(self._c_aff_hit.value),
+                "affinity_fallbacks": int(self._c_aff_fallback.value)}
+
     def merged_metrics(self):
         """Router counters + every live replica's own /metrics + the
         supervisor's restart-budget view (when wired by Fleet)."""
@@ -750,7 +825,10 @@ class FleetRouter:
         router = {"replicas": {},
                   "no_replica_sheds": int(self._c_no_replica.value),
                   "deadline_expired": int(self._c_expired.value),
-                  "session_follows": int(self._c_follow.value)}
+                  "session_follows": int(self._c_follow.value),
+                  "affinity_hits": int(self._c_aff_hit.value),
+                  "affinity_fallbacks": int(
+                      self._c_aff_fallback.value)}
         merged = {"router": router, "replicas": {}}
         for rep in reps:
             router["replicas"][rep.id] = {

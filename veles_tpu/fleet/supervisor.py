@@ -79,7 +79,8 @@ class ReplicaSupervisor:
 
     def __init__(self, models, replicas=2, router=None, *,
                  host="127.0.0.1", max_batch=64, queue_limit=256,
-                 workers=1, cache_dir=None, python=None, env=None,
+                 workers=1, cache_dir=None, kvtier_dir=None,
+                 python=None, env=None,
                  backoff=None, spawn_timeout=180.0, poll_interval=0.1,
                  fault_plans=None, clock=time.monotonic):
         items = models.items() if hasattr(models, "items") else models
@@ -90,6 +91,7 @@ class ReplicaSupervisor:
         self.queue_limit = int(queue_limit)
         self.workers = int(workers)
         self.cache_dir = cache_dir
+        self.kvtier_dir = kvtier_dir
         self.python = python or sys.executable
         self.spawn_timeout = float(spawn_timeout)
         self.poll_interval = float(poll_interval)
@@ -117,6 +119,12 @@ class ReplicaSupervisor:
             # (compilecache.resolve_config reads the env var), so every
             # spawn after the first deserializes instead of compiling
             env["VELES_COMPILE_CACHE_DIR"] = str(self.cache_dir)
+        if self.kvtier_dir and rid is not None:
+            # per-replica disk tier, path keyed by the STABLE replica id
+            # so a respawn re-opens the same index and re-advertises its
+            # surviving chains (the chaos drill's warm-restart invariant)
+            env["VELES_KVTIER_DIR"] = os.path.join(
+                str(self.kvtier_dir), rid)
         plan = self.fault_plans.get(rid) if rid is not None else None
         if plan is not None:
             env["VELES_FAULT_PLAN"] = (plan if isinstance(plan, str)

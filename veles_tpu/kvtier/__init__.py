@@ -1,0 +1,16 @@
+"""Fleet-global tiered KV cache (HBM -> host RAM -> disk).
+
+Per replica, :class:`TieredKVStore` catches chain blocks the HBM pool
+would otherwise evict and parks them down a memory hierarchy; fleet
+wide, :class:`PrefixDirectory` lets the router steer each request to
+the replica already holding the longest resident prefix of its prompt
+chain.  See docs/COMPONENTS.md "Tiered KV cache & cache-aware routing".
+"""
+
+from .store import (TieredKVStore, HostTier, DiskTier,
+                    DIR_ENV, ADVERT_HEX, advert_key)
+from .directory import PrefixDirectory, PREFIX_HEADER, prefix_key_header
+
+__all__ = ["TieredKVStore", "HostTier", "DiskTier",
+           "PrefixDirectory", "PREFIX_HEADER", "prefix_key_header",
+           "DIR_ENV", "ADVERT_HEX", "advert_key"]

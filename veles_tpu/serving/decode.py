@@ -58,6 +58,7 @@ request.
 """
 
 import collections
+import os
 import queue
 import threading
 import time
@@ -122,7 +123,8 @@ class _Session:
     """One admitted sequence: its row, blocks, and token state."""
 
     __slots__ = ("req", "row", "blocks", "length", "next_input",
-                 "generated", "first_token_s", "shared", "prefilled")
+                 "generated", "first_token_s", "shared", "prefilled",
+                 "tier")
 
     def __init__(self, req, row, blocks):
         self.req = req
@@ -134,6 +136,7 @@ class _Session:
         self.first_token_s = None
         self.shared = 0          # leading blocks attached already-resident
         self.prefilled = 0       # prompt tokens prefilled so far (chunked)
+        self.tier = None         # deepest tier serving the prefix hit
 
     @property
     def done(self):
@@ -161,7 +164,7 @@ class DecodeScheduler:
                  queue_limit=64, name="decode", metrics=None,
                  cache=None, manifest=None, warmup=True,
                  prefix_caching=False, prefill_chunk_tokens=None,
-                 spec_depth=None):
+                 spec_depth=None, kvtier=None):
         self.name = name
         self.model = model
         self.max_prompt_len = int(max_prompt_len)
@@ -259,6 +262,17 @@ class DecodeScheduler:
                 "sequence (%d tokens need %d blocks of %d)"
                 % (num_blocks, self.max_context, self.max_blocks,
                    self.block_size))
+        # tiered KV cache (veles_tpu/kvtier): None (default) keeps the
+        # evict-means-die pool exactly; a config dict — {"host_bytes",
+        # "disk_dir", "disk_bytes"} — or a ready TieredKVStore hooks
+        # the pool's eviction path so refcount-0 chains demote to host
+        # RAM / disk and admits readmit them with zero re-prefill
+        self._kvtier = self._resolve_kvtier(kvtier)
+        self._advert = None          # {"hbm": [...], "host": [...], ...}
+        self._advert_sig = None
+        if self._kvtier is not None:
+            self._pool.on_evict = self._demote_block
+            self._refresh_advert()   # disk chains advertise pre-traffic
         self._k_pools, self._v_pools = model.make_pools(
             num_blocks, self.block_size)
         # numpy mirrors of the step operands; the worker edits them on
@@ -695,6 +709,8 @@ class DecodeScheduler:
         self.metrics.set_occupancy(
             len(self._sessions), self._pool.live_blocks /
             max(self._pool.capacity, 1))
+        if self._kvtier is not None:
+            self._refresh_advert()
 
     def _admit_chunked(self, req, need, rows):
         """Admit the head-of-line request onto the chunked path: attach
@@ -702,7 +718,7 @@ class DecodeScheduler:
         the rest as private blocks, queue the session for chunk steps.
         Returns False when the pool cannot serve it yet."""
         length = len(req.prompt)
-        matched = []
+        matched, tier_hit = [], None
         if self.prefix_caching:
             # never match the whole prompt: the first output token
             # needs the hidden state at position length-1, which only
@@ -710,7 +726,13 @@ class DecodeScheduler:
             keys = key_chain(req.prompt,
                              self.block_size)[:(length - 1) //
                                               self.block_size]
-            matched = self._pool.acquire_prefix(keys)
+            hbm_matched = self._pool.acquire_prefix(keys)
+            matched = list(hbm_matched)
+            if self._kvtier is not None and len(matched) < len(keys):
+                matched, tier_hit = self._extend_from_tiers(keys,
+                                                            matched)
+            if tier_hit is None and matched:
+                tier_hit = "hbm"
         private = self._pool.alloc(need - len(matched))
         if private is None:
             if matched:
@@ -720,6 +742,7 @@ class DecodeScheduler:
         row = rows.pop(0)
         session = _Session(req, row, list(matched) + private)
         session.shared = len(matched)
+        session.tier = tier_hit
         session.prefilled = len(matched) * self.block_size
         # the page-table row stays zeroed (trash) until the final chunk
         # lands: decode steps must treat this row as padding, and a
@@ -782,7 +805,8 @@ class DecodeScheduler:
         self._sessions[session.row] = session
         self.metrics.record_first_token(
             session.first_token_s,
-            resident=session.shared * self.block_size / length)
+            resident=session.shared * self.block_size / length,
+            tier=session.tier)
         self._publish_prompt(session)
         if session.done:            # max_new_tokens == 1
             self._retire(session)
@@ -833,6 +857,114 @@ class DecodeScheduler:
             self._pool.release(shared)
         if private:
             self._pool.free(private)
+
+    # -- tiered KV cache (veles_tpu/kvtier) ----------------------------------
+    def _resolve_kvtier(self, kvtier):
+        if not kvtier:
+            return None
+        if not self.prefix_caching:
+            raise ValueError(
+                "kvtier requires prefix_caching=True — only "
+                "content-addressed chains can demote and readmit")
+        from ..kvtier import DIR_ENV, TieredKVStore
+        if isinstance(kvtier, TieredKVStore):
+            if kvtier.observer is None:
+                kvtier.observer = self.metrics
+            return kvtier
+        cfg = dict(kvtier)
+        disk_dir = cfg.get("disk_dir")
+        if disk_dir is True:
+            disk_dir = os.environ.get(DIR_ENV)
+            if not disk_dir:
+                raise ValueError(
+                    "kvtier disk tier requested but %s is not set "
+                    "(the fleet supervisor exports it per replica)"
+                    % DIR_ENV)
+        return TieredKVStore(host_bytes=int(cfg.get("host_bytes") or 0),
+                             disk_dir=disk_dir,
+                             disk_bytes=int(cfg.get("disk_bytes") or 0),
+                             observer=self.metrics)
+
+    def _demote_block(self, block, key):
+        """Pool eviction hook: capture the block's device contents
+        (still intact — eviction only reclaims the slot) and park them
+        in the tier stack.  Runs on the worker at a step boundary."""
+        tree = self._jax.tree_util
+        b = numpy.int64(int(block))
+        gather = lambda pool: numpy.asarray(pool[b])  # noqa: E731
+        payload = {
+            "kv_k": tree.tree_leaves(tree.tree_map(gather,
+                                                   self._k_pools)),
+            "kv_v": tree.tree_leaves(tree.tree_map(gather,
+                                                   self._v_pools)),
+        }
+        from .sessions import pack_block
+        self._kvtier.demote(key, pack_block(payload))
+
+    def _extend_from_tiers(self, keys, matched):
+        """Continue an :meth:`KVBlockPool.acquire_prefix` match down
+        the tier stack: each further chain key found in host RAM or on
+        disk is scattered back into a fresh HBM block, published under
+        its key, and attached to the session — the readmit that makes
+        'evicted from every HBM pool' cost zero re-prefill.  Returns
+        (matched_blocks, deepest_tier_hit)."""
+        from .sessions import unpack_block
+        tree = self._jax.tree_util
+        jnp = self._jax.numpy
+        deepest = None
+        for key in keys[len(matched):]:
+            found = self._kvtier.lookup(key)
+            if found is None:
+                break
+            tier, data = found
+            alloc = self._pool.alloc(1)
+            if alloc is None:
+                break                # pool full: prefill the rest
+            block = alloc[0]
+            payload = unpack_block(data)
+            structure = tree.tree_structure(self._k_pools)
+            scatter = lambda pool, host: pool.at[block].set(  # noqa: E731
+                jnp.asarray(host))
+            self._k_pools = tree.tree_map(
+                scatter, self._k_pools,
+                tree.tree_unflatten(structure, payload["kv_k"]))
+            self._v_pools = tree.tree_map(
+                scatter, self._v_pools,
+                tree.tree_unflatten(structure, payload["kv_v"]))
+            if not self._pool.publish(block, key):
+                # key got resident between the miss and now (cannot
+                # happen on the single worker, but stay safe): drop our
+                # copy and attach to the resident one
+                self._pool.free([block])
+                revived = self._pool.acquire_prefix([key])
+                if not revived:
+                    break
+                block = revived[0]
+            matched.append(block)
+            if deepest != "disk":
+                deepest = tier
+        return matched, deepest
+
+    def _refresh_advert(self):
+        """Rebuild the resident-chain advertisement (the ``kv_tiers``
+        payload :meth:`load` piggybacks on the router's /readyz poll)
+        when residency actually changed.  Keys travel truncated-hex,
+        capped per tier — the router only needs enough to rank
+        replicas, not the full index."""
+        from ..kvtier import advert_key
+        cap = 256
+        sig = (self._pool.published_blocks, self._pool.evicted_blocks,
+               self._kvtier.version)
+        if sig == self._advert_sig:
+            return
+        self._advert_sig = sig
+        advert = {"hbm": sorted(advert_key(k) for k in
+                                self._pool.resident_keys())[:cap]}
+        for tier, keys in self._kvtier.resident_keys().items():
+            advert[tier] = sorted(advert_key(k) for k in keys)[:cap]
+        self._advert = advert
+        used = self._kvtier.used_bytes()
+        self.metrics.set_tier_bytes(**used)
 
     def _prefill(self, session):
         req = session.req
@@ -1205,6 +1337,15 @@ class DecodeScheduler:
                 problems.append("session %s references unallocated "
                                 "block(s) %s"
                                 % (entry["session_id"], missing))
+        if self._kvtier is not None:
+            problems.extend(self._kvtier.check_integrity())
+            tiers = self._kvtier.stats()
+            resident = {"hbm": sorted(k.hex()[:12] for k in
+                                      self._pool.resident_keys())}
+            for tier, keys in self._kvtier.resident_keys().items():
+                resident[tier] = sorted(str(k)[:12] for k in keys)
+            tiers["resident"] = resident
+            dump["kvtier"] = tiers
         dump.update(model=self.name,
                     prefill_chunk_tokens=self.chunk_tokens,
                     active_sequences=len(self._sessions),
@@ -1289,7 +1430,20 @@ class DecodeScheduler:
 
     def _export_one(self, session):
         req = session.req
-        blocks = numpy.asarray(session.blocks, numpy.int64)
+        # with the tier stack on, the leading run of published blocks
+        # travels BY HASH: the chain keys are content addresses any
+        # peer can resolve against its own pool / tiers (or this
+        # replica's disk tier after a respawn), so the wire carries
+        # device bytes only for the private tail
+        hash_keys = []
+        if self._kvtier is not None:
+            for b in session.blocks:
+                key = self._pool.key_of(b)
+                if key is None:
+                    break
+                hash_keys.append(key)
+        tail = session.blocks[len(hash_keys):]
+        blocks = numpy.asarray(tail, numpy.int64)
         tree = self._jax.tree_util
         gather = lambda pool: numpy.asarray(pool[blocks])  # noqa: E731
         state = self._fresh_state(req)
@@ -1303,6 +1457,8 @@ class DecodeScheduler:
             "kv_v": tree.tree_leaves(tree.tree_map(gather,
                                                    self._v_pools)),
         })
+        if hash_keys:
+            state["kv_hash"] = [k.hex() for k in hash_keys]
         self._sessions.pop(session.row, None)
         self._by_sid.pop(req.sid, None)
         self._release_session_blocks(session, publish=False)
@@ -1340,9 +1496,36 @@ class DecodeScheduler:
                 self._depth += 1
             return sid
         rows = self._free_rows()
+        # hash-referenced lead blocks resolve against local content —
+        # HBM chains first, then the tier stack (which is how a session
+        # migrated toward its prefix's home replica readmits for free)
+        hash_hexes = [str(h) for h in state.get("kv_hash") or []]
+        lead = []
+        if hash_hexes:
+            if not self.prefix_caching:
+                if parked is not None:
+                    self._migrating[sid] = parked
+                raise ValueError(
+                    "session %r carries hashed prefix blocks but this "
+                    "scheduler has prefix_caching off" % sid)
+            keys = [bytes.fromhex(h) for h in hash_hexes]
+            lead = self._pool.acquire_prefix(keys)
+            if self._kvtier is not None and len(lead) < len(keys):
+                lead, _ = self._extend_from_tiers(keys, lead)
+            if len(lead) < len(keys):
+                if lead:
+                    self._pool.release(lead)
+                if parked is not None:
+                    self._migrating[sid] = parked
+                raise ValueError(
+                    "cannot resolve hashed prefix of session %r "
+                    "(%d/%d chain keys resident)"
+                    % (sid, len(lead), len(hash_hexes)))
         n_blocks = int(numpy.shape(state["kv_k"][0])[0])
         blocks = self._pool.alloc(n_blocks) if rows else None
         if blocks is None:
+            if lead:
+                self._pool.release(lead)
             if parked is not None:          # re-park: caller may retry
                 self._migrating[sid] = parked
             raise RuntimeError(
@@ -1362,13 +1545,14 @@ class DecodeScheduler:
             scatter, self._v_pools,
             tree.tree_unflatten(structure, state["kv_v"]))
         row = rows.pop(0)
-        session = _Session(req, row, blocks)
+        session = _Session(req, row, list(lead) + blocks)
+        session.shared = len(lead)
         session.length = int(state["length"])
         session.next_input = int(state["next_input"])
         session.generated = [int(t) for t in state["generated"]]
         session.first_token_s = float(state["first_token_s"])
         self._np_table[row, :] = 0
-        self._np_table[row, :len(blocks)] = blocks
+        self._np_table[row, :len(session.blocks)] = session.blocks
         self._np_lengths[row] = session.length
         self._np_tokens[row] = session.next_input
         self._sessions[row] = session
@@ -1475,15 +1659,23 @@ class DecodeScheduler:
         """Cheap backpressure snapshot for routers (int/float reads
         only — poll-safe)."""
         depth = self._depth
-        return {"kind": "decode",
-                "queue_depth": depth,
-                "queue_limit": self.queue_limit,
-                "utilization": round(depth / self.queue_limit, 4),
-                "active_rows": len(self._sessions),
-                "chunking_sessions": len(self._chunking),
-                "kv_occupancy": round(
-                    self._pool.live_blocks /
-                    max(self._pool.capacity, 1), 4)}
+        out = {"kind": "decode",
+               "queue_depth": depth,
+               "queue_limit": self.queue_limit,
+               "utilization": round(depth / self.queue_limit, 4),
+               "active_rows": len(self._sessions),
+               "chunking_sessions": len(self._chunking),
+               "kv_occupancy": round(
+                   self._pool.live_blocks /
+                   max(self._pool.capacity, 1), 4)}
+        advert = self._advert
+        if advert is not None:
+            # resident-chain advertisement: rides the router's /readyz
+            # load poll into its fleet-wide prefix directory (the
+            # cache-aware routing input) — a plain attribute read of a
+            # snapshot the worker swaps in whole, so still poll-safe
+            out["kv_tiers"] = advert
+        return out
 
     def retry_after_s(self, cap=30):
         """Computed ``Retry-After`` for shed generate requests: gangs
@@ -1551,4 +1743,6 @@ class DecodeScheduler:
                        evicted_blocks=pool["evicted_blocks"],
                        shared_blocks=pool["shared_blocks"],
                        cached_blocks=pool["cached_blocks"])
+        if self._kvtier is not None:
+            out["kvtier"] = self._kvtier.stats()
         return out

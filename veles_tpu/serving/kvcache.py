@@ -108,6 +108,12 @@ class KVBlockPool:
         # stay private and masked — never published, never readable)
         self.draft_rollbacks = 0         # spec iterations that rolled back
         self.rolled_back_tokens = 0      # positions written-then-discarded
+        # demotion hook: called as on_evict(block, key) just before a
+        # cached chain block is reclaimed, while its device contents are
+        # still intact — the tiered KV store (veles_tpu/kvtier) captures
+        # the block here and parks it in host RAM / on disk instead of
+        # letting the content die with the eviction
+        self.on_evict = None
 
     @property
     def free_blocks(self):
@@ -155,6 +161,8 @@ class KVBlockPool:
 
     def _evict_one(self):
         block, key = self._cached.popitem(last=False)   # LRU
+        if self.on_evict is not None:
+            self.on_evict(block, key)
         del self._key_of[block]
         del self._by_key[key]
         self._free.append(block)
@@ -265,6 +273,14 @@ class KVBlockPool:
 
     def refcount(self, block):
         return self._refs.get(int(block), 0)
+
+    def key_of(self, block):
+        """Chain key a shared/cached block is published under, or None."""
+        return self._key_of.get(int(block))
+
+    def resident_keys(self):
+        """Chain keys currently addressable in HBM (shared + cached)."""
+        return list(self._by_key)
 
     # ---------------------------------------------------------------- #
     # persistence / introspection                                      #

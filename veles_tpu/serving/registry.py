@@ -155,7 +155,8 @@ class ModelRegistry:
                            "max_new_tokens", "num_blocks",
                            "queue_limit", "cache", "manifest",
                            "warmup", "prefix_caching",
-                           "prefill_chunk_tokens", "spec_depth")}
+                           "prefill_chunk_tokens", "spec_depth",
+                           "kvtier")}
         # a model may carry its own geometry (the toydecode spec path):
         # registry-wide defaults < model defaults < explicit kwargs
         kwargs.update(getattr(model, "decode_defaults", None) or {})

@@ -1,4 +1,4 @@
-"""Wire encoding for exported decode sessions.
+"""Wire encoding for exported decode sessions and tiered KV blocks.
 
 :meth:`DecodeScheduler.export_sessions` produces state dicts with
 numpy leaves (the prompt, the per-layer K/V block contents).  Between
@@ -6,15 +6,37 @@ replicas they travel over the admin HTTP surface as JSON, so the
 arrays are framed as base64 raw bytes + dtype + shape — self-contained
 (no pickle: the peer is a different process trusting only structured
 data) and cheap relative to the device gather they carry.
+
+The same framing doubles as the **tiered KV cache's** serialization
+format (:mod:`veles_tpu.kvtier`): one demoted KV block — the per-layer
+K/V contents of a single content-addressed block — rides through
+:func:`pack_block` / :func:`unpack_block` as canonical JSON bytes
+(sorted keys, base64 raw data), so the bytes are a pure function of
+the block contents and the disk tier's content-addressed chunk store
+dedupes identical chains across sessions and restarts.
+
+Tier-resident blocks travel **by hash, not payload**: an exported
+session whose leading blocks are published under prefix keys carries
+them as a ``kv_hash`` list of chain-key hex digests (see
+:meth:`DecodeScheduler._export_one`) and ships device bytes only for
+the unpublished tail — the importer re-resolves the hashes against its
+own HBM pool and tier stack, which is what makes a prefix computed
+anywhere reusable everywhere.
 """
 
 import base64
+import json
 
 import numpy
 
-__all__ = ["pack_state", "pack_states", "unpack_state", "unpack_states"]
+__all__ = ["pack_state", "pack_states", "unpack_state", "unpack_states",
+           "pack_block", "unpack_block", "HASH_FIELD"]
 
 _ND = "__nd__"
+
+#: state-dict field carrying chain-key hex digests of leading blocks
+#: that travel by hash instead of payload (kvtier-enabled exports)
+HASH_FIELD = "kv_hash"
 
 
 def _encode(value):
@@ -60,3 +82,21 @@ def pack_states(states):
 
 def unpack_states(payloads):
     return [unpack_state(p) for p in payloads]
+
+
+def pack_block(payload):
+    """One demoted KV block → canonical bytes for the tier stack.
+
+    ``payload`` is a dict of numpy leaves (the per-layer K/V contents
+    of a single block).  The result is deterministic for given block
+    contents — sorted keys, raw-byte base64 — so content-addressing
+    the bytes (sha256) dedupes identical chains across sessions,
+    replicas and restarts.
+    """
+    return json.dumps(pack_state(payload), sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def unpack_block(data):
+    """Inverse of :func:`pack_block` (bitwise: base64 of raw bytes)."""
+    return unpack_state(json.loads(data.decode("utf-8")))

@@ -302,12 +302,26 @@ def from_spec(spec):
         elif key == "spec":
             defaults["spec_depth"] = ("auto" if value.strip() == "auto"
                                       else int(value))
+        elif key == "tier_host":
+            # host-RAM KV tier byte budget (veles_tpu/kvtier)
+            defaults.setdefault("kvtier", {})["host_bytes"] = int(value)
+        elif key == "tier_disk":
+            # "1": disk tier at $VELES_KVTIER_DIR (the supervisor sets
+            # it per replica); a literal path pins the directory; "0"
+            # leaves the disk tier off
+            v = value.strip()
+            if v != "0":
+                defaults.setdefault("kvtier", {})["disk_dir"] = \
+                    True if v == "1" else v
+        elif key == "tier_disk_bytes":
+            defaults.setdefault("kvtier", {})["disk_bytes"] = int(value)
         elif key in _GEOM_KEYS:
             defaults[_GEOM_KEYS[key]] = int(value)
         else:
             raise ValueError("unknown toydecode spec key %r (want "
                              "vocab, delay, pdelay, ddelay, agree, "
-                             "spec, %s)"
+                             "spec, tier_host, tier_disk, "
+                             "tier_disk_bytes, %s)"
                              % (key, ", ".join(sorted(_GEOM_KEYS))))
     return ToyDecodeModel(vocab=vocab, step_delay=delay,
                           prefill_delay=pdelay, draft_delay=ddelay,
