@@ -916,6 +916,64 @@ def bench_speculative(probe_timeout=300):
     return out
 
 
+def bench_flight_recorder(probe_timeout=420):
+    """Flight-recorder overhead gate (ISSUE 17 acceptance: recorder-on
+    decode tok/s within 2% of recorder-off, every anomalous request
+    leaving a persisted timeline, attribution phase shares covering
+    >= 95% of wall-clock TTFT).  Two fresh subprocesses: the overhead
+    probe interleaves recorder-on/off windows of the flagship decode
+    workload and captures one organic p99 anomaly; the attribution
+    probe reruns the shared-prefix bench with per-request tracing and
+    reports phase-share coverage."""
+    import subprocess
+    import tempfile
+    _stamp("flight-recorder stage")
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "serve_bench.py")
+    cache_dir = os.path.join(
+        tempfile.mkdtemp(prefix="veles-flight-bench-"), "compile_cache")
+
+    def probe(tag, argv):
+        proc = subprocess.run(
+            [sys.executable, tool] + argv +
+            ["--json", "--cache-dir", cache_dir],
+            capture_output=True, timeout=probe_timeout)
+        line = _last_json_line(proc.stdout.decode())
+        if line is None:
+            raise RuntimeError("flight probe (%s) failed: %s"
+                               % (tag, proc.stderr.decode()[-400:]))
+        return line
+
+    over = probe("overhead", ["--flight-overhead", "--seconds", "2"])
+    _stamp("flight overhead: %s tok/s on vs %s off (%s%%), %s "
+           "anomalies, %s persisted"
+           % (over.get("flight_on_tok_s"), over.get("flight_off_tok_s"),
+              over.get("flight_overhead_pct"),
+              over.get("flight_anomalies_captured"),
+              over.get("flight_persisted_records")))
+    attr = probe("attribution", ["--shared-prefix", "16",
+                                 "--prefix-waves", "4",
+                                 "--attribution"])
+    _stamp("flight attribution: %s request(s), coverage mean %s / "
+           "min %s" % (attr.get("attr_requests"),
+                       attr.get("attr_coverage_mean"),
+                       attr.get("attr_coverage_min")))
+    out = {k: over.get(k) for k in (
+        "flight_on_tok_s", "flight_off_tok_s", "flight_overhead_pct",
+        "flight_anomalies_captured", "flight_anomaly_reasons",
+        "flight_persisted_records", "flight_requests")}
+    anomaly = over.get("flight_anomaly_timeline") or {}
+    out["flight_anomaly_status"] = anomaly.get("status")
+    out["flight_anomaly_events"] = len(anomaly.get("events") or ())
+    out["flight_overhead_ok"] = (
+        over.get("flight_overhead_pct") is not None
+        and over["flight_overhead_pct"] < 2.0)
+    out["flight_attr_requests"] = attr.get("attr_requests")
+    out["flight_attr_coverage_mean"] = attr.get("attr_coverage_mean")
+    out["flight_attr_coverage_min"] = attr.get("attr_coverage_min")
+    return out
+
+
 def bench_fleet(replicas=3, probe_timeout=360):
     """Multi-replica serving fleet (ISSUE 7 acceptance: >= 0.8
     replica-scaling efficiency on the open-loop serve_bench load, a
@@ -1573,6 +1631,8 @@ def _stage_main(stage):
         out = bench_prefix_reuse()
     elif stage == "speculative":
         out = bench_speculative()
+    elif stage == "flight_recorder":
+        out = bench_flight_recorder()
     elif stage == "fleet":
         out = bench_fleet()
     elif stage == "fleet_prefix":
@@ -1655,6 +1715,12 @@ STAGE_PLAN = [
     # @draft/@verify executables; three fresh subprocesses over one
     # cache dir
     ("speculative", 360),
+    # flight-recorder overhead gate (ISSUE 17): recorder-on vs
+    # recorder-off decode tok/s interleaved (< 2% acceptance), one
+    # organically captured p99-anomaly timeline, and the shared-prefix
+    # attribution coverage (phase shares >= 95% of wall-clock TTFT);
+    # two fresh subprocesses over one cache dir
+    ("flight_recorder", 420),
     # multi-replica serving fleet: scaling efficiency, SIGKILL
     # kill-recovery (zero non-429 failures, warm compiles==0 respawn)
     # and rolling-update error rate (ISSUE 7) — one fresh subprocess

@@ -1,0 +1,433 @@
+"""Per-request flight recorder + tail-latency attribution (ISSUE 17).
+
+The contract under test:
+
+- every request gets ONE timeline of typed events, bounded by a
+  drop-oldest ring and a per-timeline event cap — recording never
+  grows without bound and never blocks on I/O;
+- timelines persist to JSONL only on anomaly triggers (explicit marks
+  like retry/migration, or TTFT/per-token above the rolling p99);
+- a migrated generation keeps ONE trace id: the timeline travels with
+  the session state and the destination absorbs it without duplicating
+  events (satellite: 307 + X-Veles-Migrated follow, single trace id);
+- every event kind has exactly one producer — the EventLog span bridge
+  skips span names with first-class producers, so a StepProfiler
+  attached while a decode scheduler is live cannot double-count
+  `serving.decode` steps (satellite 6);
+- `GET /api/<model>/requests` serves the ring over HTTP with the
+  client's own `X-Trace-Id` as the key;
+- attribution decomposes TTFT/per-token wall clock into phase shares
+  with the residual explicit (`other`), so coverage is measurable;
+- `tools/merge_traces.py` aligns anchor-less (SIGKILL-truncated)
+  streams onto the merged timeline instead of dropping them off-screen
+  (satellite 1).
+"""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from veles_tpu.logger import events
+from veles_tpu.observability import attribution
+from veles_tpu.observability import trace as _trace
+from veles_tpu.observability.flight import (DIRECT_SPAN_KINDS, RECORDER,
+                                            FlightRecorder)
+from veles_tpu.serving import DecodeScheduler, InferenceServer
+from veles_tpu.serving.sessions import pack_states, unpack_states
+from veles_tpu.znicz.samples.flagship import (FlagshipDecodeModel,
+                                              generate_reference)
+
+GEOM = dict(max_batch=4, block_size=4, max_prompt_len=8,
+            max_new_tokens=8)
+
+
+@pytest.fixture(scope="module")
+def model():
+    m = FlagshipDecodeModel(stages=2, experts=2, d=16, heads=2,
+                            hidden=32, vocab=32, seed=0)
+    # pin per-step wall time host-side so exports reliably catch
+    # sessions MID-generation
+    m.step_host_delay = 0.02
+    return m
+
+
+@pytest.fixture(scope="module")
+def oracle(model):
+    memo = {}
+
+    def run(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in memo:
+            memo[key] = generate_reference(model.params, prompt, n)
+        return memo[key]
+    return run
+
+
+@pytest.fixture(autouse=True)
+def clean_recorder():
+    """The process-global recorder is shared with every other serving
+    test — give each test a pristine ring and leave one behind."""
+    RECORDER.reset()
+    RECORDER.configure(persist_dir="", replica=None, enabled=True)
+    yield
+    RECORDER.reset()
+    RECORDER.configure(persist_dir="", replica=None, enabled=True)
+
+
+# -- recorder bounds -----------------------------------------------------------
+
+def test_ring_drops_oldest():
+    rec = FlightRecorder(capacity=4)
+    rec.configure(persist_dir="")
+    for i in range(6):
+        rec.record("t%d" % i, "queue.enter")
+    ids = {tl["trace_id"] for tl in rec.snapshot(limit=64)}
+    assert ids == {"t2", "t3", "t4", "t5"}
+    assert rec.get("t0") is None
+    assert rec.stats()["timelines"] == 4
+
+
+def test_per_timeline_event_cap():
+    rec = FlightRecorder(max_events=5)
+    rec.configure(persist_dir="")
+    for i in range(9):
+        rec.record("cap", "decode.step", step=i, share_s=0.001)
+    doc = rec.get("cap")
+    assert len(doc["events"]) == 5
+    assert doc["events_dropped"] == 4
+
+
+def test_step_ordinal_dedup_single_source():
+    """Two producers racing the same decode step must not double-count
+    it: the per-timeline ordinal guard keeps the first."""
+    rec = FlightRecorder()
+    rec.configure(persist_dir="")
+    rec.record_step_rows([("one", 3)], seconds=0.004)
+    rec.record_step_rows([("one", 3)], seconds=0.004)   # replay
+    rec.record("one", "decode.step", step=3, share_s=0.004)
+    steps = [e for e in rec.get("one")["events"]
+             if e["kind"] == "decode.step"]
+    assert len(steps) == 1
+    assert steps[0]["step"] == 3 and steps[0]["rows"] == 1
+
+
+def test_span_bridge_never_mirrors_direct_kinds():
+    """satellite 6: with the EventLog bridge installed AND a live span
+    context, `serving.decode`/`train.step` spans (what an attached
+    StepProfiler or DecodeMetrics emits) must not add events — only
+    kinds without a first-class producer pass through, and only into
+    timelines that already exist."""
+    rec = FlightRecorder()
+    rec.configure(persist_dir="")
+    old_sink = events.span_sink
+    rec.install_span_bridge(events)
+    try:
+        with _trace.span_context() as ctx:
+            tid = ctx.trace_id
+            rec.record(tid, "queue.enter")
+            for name in sorted(DIRECT_SPAN_KINDS):
+                events.span(name, 0.001, model="m")
+            events.span("kernel.custom_phase", 0.002)
+        doc = rec.get(tid)
+        kinds = [e["kind"] for e in doc["events"]]
+        assert kinds == ["queue.enter", "span"]
+        assert doc["events"][1]["span"] == "kernel.custom_phase"
+        # ambient spans with no existing timeline must not create one
+        with _trace.span_context():
+            events.span("kernel.custom_phase", 0.002)
+        assert rec.stats()["timelines"] == 1
+    finally:
+        events.span_sink = old_sink
+
+
+# -- anomaly persistence -------------------------------------------------------
+
+def test_only_anomalous_timelines_persist(tmp_path):
+    rec = FlightRecorder(persist_dir=str(tmp_path))
+    rec.record("calm", "queue.enter")
+    rec.finish("calm", status="ok")
+    rec.record("bad", "queue.enter")
+    rec.anomaly("bad", "retry")
+    rec.finish("bad", status="ok")
+    files = list(tmp_path.glob("flight-*.jsonl"))
+    assert len(files) == 1
+    recs = [json.loads(line) for line in open(files[0])]
+    assert [r["trace_id"] for r in recs] == ["bad"]
+    assert recs[0]["anomalies"] == ["retry"]
+    # anomaly marked AFTER finish persists immediately (e.g. the
+    # router noticing a retry after the replica answered)
+    rec.record("late", "queue.enter")
+    rec.finish("late", status="ok")
+    rec.anomaly("late", "recovery_replay")
+    recs = [json.loads(line) for line in open(files[0])]
+    assert {r["trace_id"] for r in recs} == {"bad", "late"}
+
+
+def test_rolling_p99_triggers_persistence(tmp_path):
+    rec = FlightRecorder(persist_dir=str(tmp_path), min_samples=8)
+    for i in range(20):
+        tid = "calm%d" % i
+        rec.record(tid, "queue.enter")
+        rec.finish(tid, status="ok", ttft_s=0.010, per_token_s=0.001)
+    rec.record("tail", "queue.enter")
+    rec.finish("tail", status="ok", ttft_s=0.500, per_token_s=0.001)
+    doc = rec.get("tail")
+    assert "ttft_p99" in doc["anomalies"]
+    files = list(tmp_path.glob("flight-*.jsonl"))
+    assert files, "tail latency above rolling p99 did not persist"
+    recs = [json.loads(line) for line in open(files[0])]
+    assert any(r["trace_id"] == "tail" for r in recs)
+
+
+# -- migration travel: one trace id across replicas ---------------------------
+
+def test_absorb_dedups_shared_events():
+    rec = FlightRecorder()
+    rec.configure(persist_dir="", replica="src")
+    rec.record("mig", "queue.enter", model="m")
+    rec.record("mig", "prefill.chunk", seconds=0.01)
+    exported = rec.export("mig")
+    assert exported["replica"] == "src"
+    # absorbing our own export back (source == destination process,
+    # as in in-test migrations) must not duplicate anything
+    before = len(rec.get("mig")["events"])
+    rec.absorb(exported)
+    assert len(rec.get("mig")["events"]) == before
+    # a fresh recorder tags the imported events with their origin
+    dst = FlightRecorder()
+    dst.configure(persist_dir="", replica="dst")
+    dst.absorb(exported)
+    doc = dst.get("mig")
+    assert [e["kind"] for e in doc["events"]] == ["queue.enter",
+                                                  "prefill.chunk"]
+    assert all(e["replica"] == "src" for e in doc["events"])
+
+
+def test_migrated_generation_keeps_one_trace_id(model, oracle):
+    """satellite 2: a session exported mid-generation and imported on a
+    peer scheduler finishes under the SAME trace id, and the merged
+    timeline tells the whole story — enter/admit/export on the source,
+    import and the remaining steps on the destination."""
+    a = DecodeScheduler(model, name="fla", **GEOM)
+    b = DecodeScheduler(model, name="flb", **GEOM)
+    try:
+        prompt, n = [3, 1, 4, 1, 5], 8
+        with _trace.span_context() as ctx:
+            tid = ctx.trace_id
+            fut = a.submit(prompt, n, session_id="mig0")
+        time.sleep(0.1)                 # a few steps in
+        states = a.export_sessions(["mig0"])
+        assert states, "export caught no live session"
+        assert states[0].get("trace_id") == tid
+        assert states[0].get("flight", {}).get("trace_id") == tid
+        done, errors = b.import_sessions(
+            unpack_states(pack_states(states)))
+        assert errors == [] and done == ["mig0"]
+        a.release_migrated(done, target="127.0.0.1:1")
+        marker = fut.result(30)
+        assert marker["migrated"]
+        kind, val = b.attach("mig0")
+        result = val if kind == "finished" else val.result(60)
+        assert result["tokens"] == oracle(prompt, n)
+        doc = RECORDER.get(tid)
+        kinds = [e["kind"] for e in doc["events"]]
+        for expected in ("queue.enter", "queue.admit", "first_token",
+                         "migrate.export", "migrate.import", "retire"):
+            assert expected in kinds, (expected, kinds)
+        assert kinds.index("migrate.export") < \
+            kinds.index("migrate.import")
+        assert "migration" in doc["anomalies"]
+        assert doc["status"] == "ok"
+        # ONE timeline holds it all — no per-replica fork of the id
+        assert len(RECORDER.snapshot(trace_id=tid)) == 1
+        br = attribution.phase_breakdown(doc)
+        assert br["ttft_s"] is not None and br["tokens"] == n
+    finally:
+        a.close(drain=True)
+        b.close(drain=True)
+
+
+# -- live scheduler + HTTP ring ------------------------------------------------
+
+def test_scheduler_timeline_and_http_requests_route(model, oracle):
+    """End-to-end over HTTP: the client's X-Trace-Id keys the timeline,
+    `GET /api/<model>/requests` serves it back, and attribution covers
+    the bulk of measured TTFT."""
+    srv = InferenceServer({"flag": model}, **GEOM)
+    tid = "cafe1234feedbeef"
+    try:
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/api/flag/generate" % srv.port,
+            json.dumps({"prompt": [1, 2, 3],
+                        "max_new_tokens": 4}).encode(),
+            {"Content-Type": "application/json", "X-Trace-Id": tid})
+        body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert body["tokens"] == oracle([1, 2, 3], 4)
+        doc = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/api/flag/requests?id=%s"
+            % (srv.port, tid), timeout=10).read())
+        assert "flight" in doc
+        tls = doc["requests"]
+        assert [tl["trace_id"] for tl in tls] == [tid]
+        kinds = [e["kind"] for e in tls[0]["events"]]
+        for expected in ("request.recv", "queue.enter", "queue.admit",
+                         "first_token", "decode.step", "retire",
+                         "request.done"):
+            assert expected in kinds, (expected, kinds)
+        assert tls[0]["meta"]["model"] == "flag"
+        assert tls[0]["status"] == "ok"
+        br = attribution.phase_breakdown(tls[0])
+        assert br["ttft_s"] is not None
+        assert br["coverage"] is not None and br["coverage"] > 0.5
+        # the unfiltered ring lists the same request
+        doc = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/api/flag/requests" % srv.port,
+            timeout=10).read())
+        assert tid in {tl["trace_id"] for tl in doc["requests"]}
+    finally:
+        srv.stop()
+
+
+def test_fleet_requests_route_merges_router_and_replica(model, oracle):
+    """`GET /fleet/requests` groups the router's own dispatch timeline
+    with the replica's serving timeline under ONE trace id — the
+    cross-process stitch `tools/request_inspect.py --fleet` renders."""
+    from tools.request_inspect import stitch
+    from veles_tpu.fleet.router import FleetRouter
+    srv = InferenceServer({"flag": model}, **GEOM)
+    router = FleetRouter(port=0, poll_interval=0.05)
+    try:
+        router.add_replica("r0", "127.0.0.1", srv.port)
+        deadline = time.time() + 10
+        while router.ready_count() < 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert router.ready_count() == 1
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/api/flag/generate" % router.port,
+            json.dumps({"prompt": [2, 7],
+                        "max_new_tokens": 3}).encode(),
+            {"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=30)
+        tid = resp.headers.get("X-Trace-Id")
+        assert json.loads(resp.read())["tokens"] == oracle([2, 7], 3)
+        assert tid
+        doc = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/fleet/requests?id=%s"
+            % (router.port, urllib.parse.quote(tid)),
+            timeout=10).read())
+        frags = doc["requests"][tid]
+        sources = {tl.get("replica") for tl in frags}
+        assert "router" in sources and "r0" in sources
+        assert "router" in doc["flight"] and "r0" in doc["flight"]
+        merged = stitch(frags)
+        kinds = [e["kind"] for e in merged["events"]]
+        assert "router.dispatch" in kinds      # router-side producer
+        assert "first_token" in kinds          # replica-side producer
+        assert merged["replicas"] == ["r0", "router"]
+        assert merged["status"] == "ok"
+    finally:
+        router.stop()
+        srv.stop()
+
+
+# -- attribution math ----------------------------------------------------------
+
+def test_phase_breakdown_synthetic_sums():
+    t0 = 1000.0
+    tl = {"trace_id": "x", "started_unix": t0,
+          "finished_unix": t0 + 1.0, "status": "ok",
+          "events": [
+              {"t": t0, "kind": "queue.enter"},
+              {"t": t0 + 0.2, "kind": "queue.admit"},
+              # chunk COMPLETES at 0.5 after 0.1s of compute: the
+              # 0.2..0.4 gap is service wait, credited to queue
+              {"t": t0 + 0.5, "kind": "prefill.chunk", "seconds": 0.1},
+              {"t": t0 + 0.6, "kind": "first_token", "ttft_s": 0.6},
+              {"t": t0 + 0.7, "kind": "decode.step", "step": 1,
+               "share_s": 0.05, "rows": 2},
+              {"t": t0 + 0.9, "kind": "tier.hit", "seconds": 0.02},
+              {"t": t0 + 1.0, "kind": "retire", "tokens": 5},
+          ]}
+    br = attribution.phase_breakdown(tl)
+    assert br["ttft_s"] == pytest.approx(0.6)
+    ph = br["ttft_phases"]
+    assert ph["queue"] == pytest.approx(0.4)
+    assert ph["prefill"] == pytest.approx(0.1)
+    assert ph["other"] == pytest.approx(0.1)
+    assert br["coverage"] == pytest.approx(0.5 / 0.6)
+    assert br["per_token_s"] == pytest.approx(0.4 / 4)
+    dp = br["decode_phases"]
+    assert dp["decode"] == pytest.approx(0.05)
+    assert dp["tier"] == pytest.approx(0.02)
+    assert dp["other"] == pytest.approx(0.33)
+    # phases + residual account for the full measured wall clock
+    assert sum(ph.values()) == pytest.approx(br["ttft_s"])
+    assert sum(dp.values()) == pytest.approx(0.4)
+
+
+def test_aggregate_groups_and_renders():
+    def mk(tid, replica, ttft):
+        t0 = 100.0
+        return {"trace_id": tid, "started_unix": t0, "replica": replica,
+                "finished_unix": t0 + ttft + 0.1, "status": "ok",
+                "events": [
+                    {"t": t0, "kind": "queue.enter"},
+                    {"t": t0 + ttft * 0.5, "kind": "queue.admit"},
+                    {"t": t0 + ttft, "kind": "prefill.chunk",
+                     "seconds": ttft * 0.5},
+                    {"t": t0 + ttft, "kind": "first_token",
+                     "ttft_s": ttft},
+                    {"t": t0 + ttft + 0.1, "kind": "retire",
+                     "tokens": 2},
+                ]}
+    tls = [mk("a", "r0", 0.2), mk("b", "r0", 0.4), mk("c", "r1", 1.0)]
+    agg = attribution.aggregate(tls, group_by=("replica",))
+    assert set(agg) == {"r0", "r1"}
+    assert agg["r0"]["count"] == 2 and agg["r1"]["count"] == 1
+    assert agg["r1"]["ttft_ms"]["p99"] == pytest.approx(1000.0)
+    pct = agg["r1"]["ttft_phase_pct"]
+    assert pct["queue"] == pytest.approx(50.0)
+    assert pct["prefill"] == pytest.approx(50.0)
+    assert agg["r1"]["coverage"] == pytest.approx(1.0)
+    report = attribution.render_report(agg, group_by=("replica",))
+    assert "r0" in report and "r1" in report and "coverage" in report
+
+
+# -- merge_traces: SIGKILL-truncated streams (satellite 1) --------------------
+
+def test_merge_traces_anchorless_stream_aligns(tmp_path, capsys):
+    """A stream whose trace_start header never flushed (the process was
+    SIGKILLed first) still lands on the merged timeline: its earliest
+    event is rebased to t=0 with a stderr warning, instead of sitting
+    at a raw per-process perf_counter epoch hours off-screen."""
+    from tools.merge_traces import merge
+    ok = tmp_path / "events-1.jsonl"
+    ok.write_text(
+        json.dumps({"name": "trace_start", "ph": "i", "ts": 0.0,
+                    "pid": 1, "tid": 1,
+                    "args": {"unix_time_s": 1000.0}}) + "\n" +
+        json.dumps({"name": "job.run", "ph": "X", "ts": 50.0,
+                    "dur": 10.0, "pid": 1, "tid": 1}) + "\n")
+    torn = tmp_path / "events-2.jsonl"
+    torn.write_text(
+        json.dumps({"name": "decode.step", "ph": "X", "ts": 5e9,
+                    "dur": 5.0, "pid": 2, "tid": 2}) + "\n" +
+        json.dumps({"name": "decode.step", "ph": "X", "ts": 5e9 + 40,
+                    "dur": 5.0, "pid": 2, "tid": 2}) + "\n")
+    doc = merge([str(ok), str(torn)])
+    assert "no trace_start anchor" in capsys.readouterr().err
+    by_pid = {}
+    for rec in doc["traceEvents"]:
+        by_pid.setdefault(rec["pid"], []).append(rec["ts"])
+    assert min(by_pid[2]) == pytest.approx(0.0)
+    assert max(by_pid[2]) == pytest.approx(40.0)
+    assert by_pid[1] == [0.0, 50.0]
+    # unanchored-only merges keep raw timestamps (no origin to rebase
+    # onto) and stay warning-free
+    capsys.readouterr()
+    doc = merge([str(torn)])
+    assert not capsys.readouterr().err
+    assert min(r["ts"] for r in doc["traceEvents"]) == pytest.approx(5e9)

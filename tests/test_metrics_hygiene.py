@@ -1,0 +1,123 @@
+"""Metric-name hygiene over the LIVE exposition (ISSUE 17 satellite).
+
+Boots the full serving surface in one process — a stock inference
+server with a decode model (serving + decode + KV-tier + flight
+families) and a fleet router (fleet families) — then scrapes the
+Prometheus text exposition from a live status server `/metrics` (the
+one registry every subsystem records into) and asserts:
+
+- every exposed family name matches ``veles_[a-z0-9_]+`` — one
+  namespace, lowercase, no typos smuggled in by a new subsystem;
+- no family is declared twice in one exposition (duplicate `# TYPE`
+  lines are how colliding registrations surface to Prometheus);
+- every exposed `veles_*` family is documented in the metrics
+  reference table in docs/COMPONENTS.md — the failure message lists
+  the undocumented names so the fix is mechanical.
+"""
+
+import json
+import os
+import re
+import urllib.request
+
+import pytest
+
+from veles_tpu.znicz.samples.flagship import FlagshipDecodeModel
+
+NAME_RE = re.compile(r"^veles_[a-z0-9_]+$")
+DOCS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "COMPONENTS.md")
+
+
+def _families(text):
+    """family -> list of TYPE declarations in one exposition."""
+    fams = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            fams.setdefault(name, []).append(kind)
+    return fams
+
+
+def _sample_families(text):
+    """Family names as seen on sample lines (histogram suffixes and
+    label blocks stripped)."""
+    out = set()
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                name = name[:-len(suffix)]
+        out.add(name)
+    return out
+
+
+@pytest.fixture(scope="module")
+def exposition():
+    """Prometheus text scraped over HTTP with the serving + fleet
+    surface registered and exercised."""
+    from veles_tpu.fleet.router import FleetRouter
+    from veles_tpu.serving import InferenceServer
+    from veles_tpu.web_status import StatusRegistry, StatusServer
+    model = FlagshipDecodeModel(stages=2, experts=2, d=16, heads=2,
+                                hidden=32, vocab=32, seed=0)
+    srv = InferenceServer({"flag": model}, max_batch=4, block_size=4,
+                          max_prompt_len=8, max_new_tokens=8)
+    router = FleetRouter(port=0)
+    status = StatusServer(0, StatusRegistry())
+    try:
+        # drive one request through so request/decode series have
+        # children (an idle family exports nothing to scrape)
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/api/flag/generate" % srv.port,
+            json.dumps({"prompt": [1, 2],
+                        "max_new_tokens": 2}).encode(),
+            {"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=30).read()
+        resp = urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % status.port, timeout=10)
+        assert resp.headers.get_content_type() == "text/plain"
+        text = resp.read().decode("utf-8")
+    finally:
+        status.stop()
+        router.stop()
+        srv.stop()
+    return text
+
+
+def test_every_family_matches_namespace(exposition):
+    fams = _families(exposition)
+    assert fams, "exposition carried no TYPE lines"
+    bad = sorted(n for n in fams if not NAME_RE.match(n))
+    assert not bad, "non-conforming metric names: %s" % bad
+    stray = sorted(n for n in _sample_families(exposition)
+                   if not NAME_RE.match(n))
+    assert not stray, "non-conforming sample names: %s" % stray
+
+
+def test_serving_and_flight_families_present(exposition):
+    fams = _families(exposition)
+    for expected in ("veles_serving_decode_tokens_total",
+                     "veles_fleet_dispatch_total",
+                     "veles_flight_requests_total",
+                     "veles_flight_events_total"):
+        assert expected in fams, expected
+
+
+def test_no_duplicate_registrations(exposition):
+    dups = {n: kinds for n, kinds in _families(exposition).items()
+            if len(kinds) > 1}
+    assert not dups, "families declared more than once: %s" % dups
+
+
+def test_every_scraped_family_is_documented(exposition):
+    with open(DOCS) as f:
+        documented = set(re.findall(r"`(veles_[a-z0-9_]+)`", f.read()))
+    assert documented, "docs/COMPONENTS.md lists no veles_* series"
+    undocumented = sorted(set(_families(exposition)) - documented)
+    assert not undocumented, (
+        "metrics exposed at /metrics but missing from the reference "
+        "table in docs/COMPONENTS.md (add one row per family): %s"
+        % undocumented)

@@ -60,16 +60,26 @@ def merge(paths, trace_id=None):
     anchored_starts = []
     for path in paths:
         events, anchor = read_events(path)
-        streams.append((events, anchor))
+        streams.append((path, events, anchor))
         if anchor is not None:
             anchored_starts.append(anchor[1] - anchor[0] / 1e6)
     # absolute time of the earliest anchored process start becomes t=0
     origin = min(anchored_starts) if anchored_starts else None
     merged = []
-    for events, anchor in streams:
+    for path, events, anchor in streams:
         if anchor is not None and origin is not None:
             ts0, unix0 = anchor
             offset = (unix0 - ts0 / 1e6 - origin) * 1e6
+        elif origin is not None and events:
+            # no trace_start anchor — the process was SIGKILLed before
+            # (or while) the header flushed.  Best effort: rebase the
+            # file's earliest event to the merged origin so its spans
+            # at least land on the visible timeline instead of at an
+            # arbitrary per-process perf_counter epoch.
+            offset = -min(float(rec["ts"]) for rec in events)
+            print("merge_traces: %s has no trace_start anchor "
+                  "(truncated?); aligning its first event to t=0"
+                  % path, file=sys.stderr)
         else:
             offset = 0.0
         for rec in events:

@@ -1,0 +1,242 @@
+"""Render flight-recorder request timelines and tail-latency blame.
+
+Usage::
+
+    python tools/request_inspect.py http://HOST:PORT                # replica
+    python tools/request_inspect.py http://HOST:PORT --model NAME   # one model
+    python tools/request_inspect.py http://ROUTER --fleet           # merged
+    python tools/request_inspect.py ... --id TRACEID                # one request
+    python tools/request_inspect.py --dir /tmp/flight               # offline
+    python tools/request_inspect.py ... --json                      # machine output
+
+The serving sibling of ``tools/kv_inspect.py``: where that tool reads
+the KV pool, this one reads the always-on flight recorder
+(veles_tpu/observability/flight.py) — the per-request timeline of
+router dispatch, queue admission, prefill chunks, per-row decode-step
+shares, speculation, KV-tier readmits and migration hops — and runs
+the attribution pass (observability/attribution.py) over it, so a
+slow request answers "where did the time go" phase by phase.
+
+Three sources, one rendering:
+
+- a single replica's ``GET /api/<model>/requests`` ring snapshot;
+- a fleet router's ``GET /fleet/requests`` — the same timelines
+  merged across the router and every live replica, stitched by trace
+  id, so a migrated session reads as ONE story across two processes;
+- ``--dir``: offline ``flight-*.jsonl`` files persisted on anomaly
+  (deadline 504 / 429 shed / retry / migration / p99 outlier), e.g.
+  after a chaos drill or a SIGKILL the servers did not survive.
+
+Without ``--id`` the tool also prints the aggregate attribution
+report — p50/p95/p99 TTFT and per-token latency decomposed into
+queue / prefill / decode / verify / tier / migration shares, grouped
+per tenant tag and per replica — the fleet-wide tail-latency view.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import urllib.parse
+import urllib.request
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+from veles_tpu.observability import attribution  # noqa: E402
+
+
+def fetch_json(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def fetch_live(base_url, fleet=False, model=None, trace_id=None,
+               timeout=10.0):
+    """→ (tid → [timeline fragments], recorder stats dict)."""
+    base = base_url.rstrip("/")
+    if fleet:
+        url = base + "/fleet/requests"
+        if trace_id:
+            url += "?id=" + urllib.parse.quote(trace_id)
+        doc = fetch_json(url, timeout)
+        return dict(doc.get("requests") or {}), doc.get("flight") or {}
+    url = "%s/api/%s/requests" % (base, model or "")
+    url = url.replace("//requests", "/requests")
+    if trace_id:
+        url += "?id=" + urllib.parse.quote(trace_id)
+    doc = fetch_json(url, timeout)
+    grouped = {}
+    for tl in doc.get("requests") or ():
+        tid = tl.get("trace_id")
+        if tid:
+            grouped.setdefault(tid, []).append(tl)
+    return grouped, {"local": doc.get("flight")}
+
+
+def load_dir(path, trace_id=None):
+    """Offline mode: every ``flight-*.jsonl`` under ``path``
+    (recursively — the supervisor keeps one subdir per replica)."""
+    grouped = {}
+    pattern = os.path.join(path, "**", "flight-*.jsonl")
+    files = sorted(glob.glob(pattern, recursive=True)) + \
+        sorted(glob.glob(os.path.join(path, "flight-*.jsonl")))
+    for fp in dict.fromkeys(files):
+        replica = os.path.basename(os.path.dirname(fp))
+        with open(fp) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    tl = json.loads(line)
+                except ValueError:
+                    continue        # torn tail write (SIGKILL)
+                tid = tl.get("trace_id") if isinstance(tl, dict) \
+                    else None
+                if not tid or (trace_id and tid != trace_id):
+                    continue
+                tl.setdefault("replica", replica)
+                grouped.setdefault(tid, []).append(tl)
+    return grouped
+
+
+def stitch(fragments):
+    """Per-replica timeline fragments of ONE trace id → one merged
+    timeline dict (events deduped on (t, kind) — a migrated session's
+    exported prefix exists on both sides of the hop)."""
+    merged = {"trace_id": fragments[0].get("trace_id"),
+              "events": [], "anomalies": [], "meta": {}}
+    seen = set()
+    starts, ends = [], []
+    for tl in fragments:
+        rep = tl.get("replica")
+        if isinstance(tl.get("started_unix"), (int, float)):
+            starts.append(tl["started_unix"])
+        if isinstance(tl.get("finished_unix"), (int, float)):
+            ends.append(tl["finished_unix"])
+        for reason in tl.get("anomalies") or ():
+            if reason not in merged["anomalies"]:
+                merged["anomalies"].append(reason)
+        merged["meta"].update(tl.get("meta") or {})
+        status = tl.get("status")
+        if status and (merged.get("status") in (None, "open", "ok")
+                       or status != "open"):
+            merged["status"] = status
+        for ev in tl.get("events") or ():
+            key = (round(float(ev.get("t", 0.0)), 6), ev.get("kind"))
+            if key in seen:
+                continue
+            seen.add(key)
+            ev = dict(ev)
+            if rep and "replica" not in ev:
+                ev["replica"] = rep
+            merged["events"].append(ev)
+    merged["events"].sort(key=lambda e: e.get("t", 0.0))
+    if starts:
+        merged["started_unix"] = min(starts)
+    if ends:
+        merged["finished_unix"] = max(ends)
+    merged["replicas"] = sorted(
+        {tl.get("replica") for tl in fragments if tl.get("replica")})
+    if merged["replicas"]:
+        # the aggregate groups on this key — a migrated request shows
+        # up under its full hop chain, not hidden under one side
+        merged["replica"] = ",".join(merged["replicas"])
+    return merged
+
+
+def describe(tl):
+    """One stitched timeline → the human rendering."""
+    lines = []
+    t0 = tl.get("started_unix")
+    lines.append("request %s  status=%s  replicas=%s%s"
+                 % (tl.get("trace_id"), tl.get("status", "open"),
+                    ",".join(tl.get("replicas") or ["-"]),
+                    "  ANOMALIES=" + ",".join(tl["anomalies"])
+                    if tl.get("anomalies") else ""))
+    for ev in tl.get("events") or ():
+        rel = ev.get("t", 0.0) - t0 if t0 is not None else ev.get("t")
+        extra = " ".join(
+            "%s=%s" % (k, v) for k, v in sorted(ev.items())
+            if k not in ("t", "kind", "replica"))
+        lines.append("  %+10.4fs  %-14s %-8s %s"
+                     % (rel, ev.get("kind", "?"),
+                        ev.get("replica", ""), extra))
+    attr = attribution.phase_breakdown(tl)
+    if attr.get("ttft_s") is not None:
+        shares = attr.get("ttft_phases") or {}
+        lines.append("  ttft %.4fs  (coverage %.0f%%): %s"
+                     % (attr["ttft_s"],
+                        100.0 * (attr.get("coverage") or 0.0),
+                        "  ".join("%s=%.4fs" % (p, shares[p])
+                                  for p in attribution.PHASES
+                                  if shares.get(p))))
+    if attr.get("per_token_s") is not None:
+        shares = attr.get("decode_phases") or {}
+        lines.append("  per-token %.5fs over %d token(s): %s"
+                     % (attr["per_token_s"], attr.get("tokens") or 0,
+                        "  ".join("%s=%.5fs" % (p, shares[p])
+                                  for p in attribution.PHASES
+                                  if shares.get(p))))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", nargs="?",
+                    help="replica or router base URL (http://host:port)"
+                         "; omit with --dir")
+    ap.add_argument("--fleet", action="store_true",
+                    help="URL is a fleet router: read the merged "
+                         "/fleet/requests route")
+    ap.add_argument("--model", help="replica mode: one model's ring "
+                                    "(default: every model)")
+    ap.add_argument("--id", dest="trace_id",
+                    help="one trace id (as returned in X-Trace-Id)")
+    ap.add_argument("--dir", dest="flight_dir",
+                    help="offline: read flight-*.jsonl under this "
+                         "directory instead of a live server")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON document instead of text")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    if not args.flight_dir and not args.url:
+        ap.error("either a URL or --dir is required")
+    if args.flight_dir:
+        grouped = load_dir(args.flight_dir, args.trace_id)
+        stats = {}
+    else:
+        grouped, stats = fetch_live(
+            args.url, fleet=args.fleet, model=args.model,
+            trace_id=args.trace_id, timeout=args.timeout)
+    stitched = {tid: stitch(frags) for tid, frags in grouped.items()
+                if frags}
+
+    if args.json:
+        doc = {"requests": stitched, "flight": stats}
+        if len(stitched) > 1:
+            doc["attribution"] = attribution.aggregate(
+                stitched.values())
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 0 if stitched or not args.trace_id else 1
+
+    if args.trace_id and not stitched:
+        print("request_inspect: trace %s not found" % args.trace_id,
+              file=sys.stderr)
+        return 1
+    order = sorted(stitched.values(),
+                   key=lambda tl: tl.get("started_unix") or 0.0)
+    for tl in order:
+        print(describe(tl))
+    if len(order) > 1:
+        agg = attribution.aggregate(order)
+        print()
+        print(attribution.render_report(agg))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -482,12 +482,162 @@ def run_decode_bench(seconds=2.0, n_requests=None, max_batch=8,
     return out
 
 
+# -- flight-recorder overhead mode --------------------------------------------
+
+
+def _run_traced(scheduler, requests):
+    """``_run_continuous`` with one fresh trace context per request, so
+    every submission opens its own flight timeline (the bench drives
+    the scheduler directly — there is no HTTP layer minting
+    ``X-Trace-Id`` here)."""
+    from veles_tpu.observability import trace as _trace
+    t0 = time.perf_counter()
+    futures = []
+    for p, n in requests:
+        with _trace.span_context():
+            futures.append(scheduler.submit(p, n))
+    results = [f.result(120) for f in futures]
+    elapsed = time.perf_counter() - t0
+    tokens = sum(len(r["tokens"]) for r in results)
+    return tokens, elapsed, results
+
+
+def run_flight_bench(seconds=2.0, n_requests=None, rounds=6,
+                     cache_dir=None):
+    """The flight-recorder overhead gate (ISSUE 17 acceptance:
+    recorder-on decode tok/s within 2% of recorder-off) plus one
+    organically captured anomaly timeline.
+
+    Phase A fills the rolling TTFT window with calm one-at-a-time
+    short requests, then bursts full-length prompts — the stragglers'
+    TTFT lands above the calm p99, which IS the anomaly trigger, so
+    the timelines persist to the JSONL spool exactly as they would in
+    production.  Phase B interleaves recorder-on and recorder-off
+    windows of the same flagship decode workload (drift cancels, like
+    the continuous/static pair) and reports the throughput delta."""
+    from veles_tpu.observability import attribution
+    from veles_tpu.observability.flight import RECORDER
+    from veles_tpu.serving import DecodeScheduler
+    from veles_tpu.znicz.samples.flagship import FlagshipDecodeModel
+
+    if cache_dir:
+        from veles_tpu.config import root
+        root.common.compile_cache.dir = cache_dir
+    max_batch, block_size = 8, 8
+    max_prompt_len, max_new_tokens = 16, 16
+    model = FlagshipDecodeModel(stages=2, experts=2, d=32, heads=2,
+                                hidden=64, vocab=128, seed=0)
+    scheduler = DecodeScheduler(
+        model, max_batch=max_batch, block_size=block_size,
+        max_prompt_len=max_prompt_len, max_new_tokens=max_new_tokens,
+        queue_limit=4096, name="flight_bench")
+    if n_requests is None:
+        # longer windows than --decode: the on/off delta being gated
+        # is small, so each timed window must dominate scheduler noise
+        n_requests = max(24 * max_batch, int(96 * seconds))
+    requests = _decode_requests(n_requests, max_prompt_len,
+                                max_new_tokens, model.vocab)
+    long_prompt = list(range(1, max_prompt_len + 1))
+    spool = tempfile.mkdtemp(prefix="veles-flight-bench-")
+    RECORDER.reset()
+    RECORDER.configure(persist_dir=spool, replica="bench",
+                       enabled=False)
+    out = {"flight_requests": n_requests, "flight_rounds": rounds,
+           "flight_spool_dir": spool}
+    on = {"tokens": 0.0, "t": 0.0}
+    off = {"tokens": 0.0, "t": 0.0}
+    try:
+        # warm every shape FIRST, recorder off: the one giant
+        # first-compile TTFT must not land in the rolling window, else
+        # the burst below compares against it and the p99 trigger
+        # never fires
+        _run_traced(scheduler, [([3, 1], 1)])
+        _run_traced(scheduler,
+                    [(long_prompt, max_new_tokens)] * max_batch)
+        _run_traced(scheduler, requests[:max_batch])
+        RECORDER.configure(enabled=True)
+
+        # -- phase A: capture a real anomaly ------------------------------
+        for _ in range(RECORDER.min_samples + 4):  # calm: tiny TTFTs
+            _run_traced(scheduler, [([3, 1], 1)])
+        _run_traced(scheduler,
+                    [(long_prompt, max_new_tokens)] * (2 * max_batch))
+        anomalous = [tl for tl in RECORDER.snapshot(limit=256)
+                     if tl.get("anomalies")]
+        out["flight_anomalies_captured"] = len(anomalous)
+        if anomalous:
+            out["flight_anomaly_timeline"] = anomalous[0]
+            out["flight_anomaly_reasons"] = sorted(
+                {r for tl in anomalous for r in tl["anomalies"]})
+        out["flight_persisted_records"] = _spool_records(spool)
+        RECORDER.reset()            # fresh windows for the timed phase
+        # a fresh p99 window would flag the timed phase's own tail as
+        # anomalous and pay JSONL writes mid-measurement — persistence
+        # is phase A's job, the timed phase measures recording alone
+        RECORDER.configure(persist_dir="")
+
+        # -- phase B: recorder-on vs recorder-off, interleaved ------------
+        _run_traced(scheduler, requests[:max_batch])   # warm untimed
+        for r in range(max(1, rounds)):
+            order = (True, False) if r % 2 == 0 else (False, True)
+            for enabled in order:   # alternating order cancels drift
+                RECORDER.configure(enabled=enabled)
+                tok, dt, _res = _run_traced(scheduler, requests)
+                acc = on if enabled else off
+                acc["tokens"] += tok
+                acc["t"] += dt
+        RECORDER.configure(enabled=True)
+        tls = RECORDER.snapshot(limit=256)
+    finally:
+        scheduler.close(drain=True)
+    out["flight_on_tok_s"] = round(on["tokens"] / on["t"], 1)
+    out["flight_off_tok_s"] = round(off["tokens"] / off["t"], 1)
+    out["flight_overhead_pct"] = round(
+        100.0 * (out["flight_off_tok_s"] - out["flight_on_tok_s"])
+        / out["flight_off_tok_s"], 2)
+    covs = [b["coverage"] for b in map(attribution.phase_breakdown, tls)
+            if b.get("coverage") is not None]
+    if covs:
+        out["flight_attr_coverage_mean"] = round(
+            sum(covs) / len(covs), 4)
+    return out
+
+
+def _spool_records(spool):
+    count = 0
+    for fn in os.listdir(spool):
+        if fn.startswith("flight-") and fn.endswith(".jsonl"):
+            with open(os.path.join(spool, fn)) as f:
+                count += sum(1 for line in f if line.strip())
+    return count
+
+
+def attribution_summary(group_by=("model",), limit=256):
+    """Phase-share table over the process-global recorder's finished
+    timelines — the ``--attribution`` payload appended to a bench's
+    JSON line (acceptance: phase shares cover >= 95% of wall-clock
+    TTFT on the shared-prefix bench)."""
+    from veles_tpu.observability import attribution
+    from veles_tpu.observability.flight import RECORDER
+    tls = RECORDER.snapshot(limit=limit)
+    covs = [b["coverage"] for b in map(attribution.phase_breakdown, tls)
+            if b.get("coverage") is not None]
+    agg = attribution.aggregate(tls, group_by=group_by)
+    out = {"attr_requests": len(tls),
+           "attr_phase_table": agg}
+    if covs:
+        out["attr_coverage_mean"] = round(sum(covs) / len(covs), 4)
+        out["attr_coverage_min"] = round(min(covs), 4)
+    return out
+
+
 # -- prefix / chunked-prefill mode --------------------------------------------
 
 
 def run_prefix_bench(shared_prefix=16, waves=10, long_prompts=3,
                      prompt_len=64, chunk_tokens=8, followers=8,
-                     prefill_delay=0.002, cache_dir=None):
+                     prefill_delay=0.002, cache_dir=None,
+                     attribution=False):
     """The chunked-prefill + prefix-reuse acceptance probe (ISSUE 14).
 
     Phase A — head-of-line blocking: a short request submitted behind
@@ -508,6 +658,21 @@ def run_prefix_bench(shared_prefix=16, waves=10, long_prompts=3,
     if cache_dir:
         from veles_tpu.config import root
         root.common.compile_cache.dir = cache_dir
+    if attribution:
+        # every submission gets its own trace context so the flight
+        # recorder opens a timeline per request; the phase-share table
+        # rides the bench JSON (attr_* keys)
+        from veles_tpu.observability.flight import RECORDER
+        RECORDER.reset()
+        RECORDER.configure(enabled=True)
+
+    def _submit(scheduler, prompt, n):
+        if not attribution:
+            return scheduler.submit(prompt, n)
+        from veles_tpu.observability import trace as _trace
+        with _trace.span_context():
+            return scheduler.submit(prompt, n)
+
     out = {"prefix_shared_tokens": shared_prefix,
            "prefix_chunk_tokens": chunk_tokens,
            "prefix_long_prompts": long_prompts,
@@ -532,8 +697,9 @@ def run_prefix_bench(shared_prefix=16, waves=10, long_prompts=3,
         try:
             warm = scheduler.stats()["compiles"]
             for _ in range(max(1, waves)):
-                futures = [scheduler.submit(p, 8) for p in long_reqs]
-                short = scheduler.submit(short_req, 8)
+                futures = [_submit(scheduler, p, 8)
+                           for p in long_reqs]
+                short = _submit(scheduler, short_req, 8)
                 ttfts.append(short.result(120)["ttft_s"])
                 for f in futures:
                     f.result(120)
@@ -568,11 +734,12 @@ def run_prefix_bench(shared_prefix=16, waves=10, long_prompts=3,
     try:
         warm_compiles = scheduler.stats()["compiles"]
         seed_prompt = prefix + [91]
-        assert scheduler.submit(seed_prompt, 8).result(120)["tokens"] \
+        assert _submit(scheduler, seed_prompt, 8).result(120)["tokens"] \
             == oracle(seed_prompt, 8)
         mismatches = 0
         fut = [(prefix + [40 + i, 41 + i, 42 + i],
-                scheduler.submit(prefix + [40 + i, 41 + i, 42 + i], 8))
+                _submit(scheduler,
+                        prefix + [40 + i, 41 + i, 42 + i], 8))
                for i in range(followers)]
         for prompt, f in fut:
             if f.result(120)["tokens"] != oracle(prompt, 8):
@@ -592,6 +759,8 @@ def run_prefix_bench(shared_prefix=16, waves=10, long_prompts=3,
     out["prefix_compiles"] = stats["compiles"]
     out["prefix_post_warmup_compiles"] = (stats["compiles"]
                                           - warm_compiles)
+    if attribution:
+        out.update(attribution_summary())
     return out
 
 
@@ -1306,12 +1475,44 @@ def main(argv=None):
                         "twice — least-loaded vs X-Veles-Prefix-Keys "
                         "affinity — comparing prefix-hit rate and "
                         "TTFT p99")
+    p.add_argument("--flight-overhead", action="store_true",
+                   help="flight-recorder overhead gate: recorder-on "
+                        "vs recorder-off decode tok/s interleaved, "
+                        "plus one organically captured anomaly "
+                        "timeline (ISSUE 17: overhead < 2%%)")
+    p.add_argument("--attribution", action="store_true",
+                   help="with --shared-prefix: trace every request "
+                        "and append the flight-recorder phase-share "
+                        "table (attr_* keys) to the bench JSON")
     p.add_argument("--chaos", type=int, default=None, metavar="N",
                    help="chaos drill mode: N replicas with scripted "
                         "fault plans (SIGKILL, truncation, black-hole, "
                         "SIGSTOP) under a deadline-carrying open loop "
                         "— the zero-failed-responses acceptance drill")
     args = p.parse_args(argv)
+
+    if args.flight_overhead:
+        out = run_flight_bench(
+            seconds=args.seconds, n_requests=args.decode_requests,
+            cache_dir=args.cache_dir)
+        line = {"metric": "flight_overhead_pct",
+                "value": out.get("flight_overhead_pct"), "unit": "%"}
+        line.update(out)
+        if not args.json:
+            print("flight bench: %s tok/s recorder-on vs %s off "
+                  "(overhead %s%%); %s anomalies captured (%s), %s "
+                  "persisted record(s), attribution coverage %s"
+                  % (out.get("flight_on_tok_s"),
+                     out.get("flight_off_tok_s"),
+                     out.get("flight_overhead_pct"),
+                     out.get("flight_anomalies_captured"),
+                     ",".join(out.get("flight_anomaly_reasons") or [])
+                     or "-",
+                     out.get("flight_persisted_records"),
+                     out.get("flight_attr_coverage_mean")),
+                  file=sys.stderr)
+        print(json.dumps(line))
+        return 0
 
     if args.chaos:
         out = run_chaos_bench(
@@ -1431,7 +1632,8 @@ def main(argv=None):
     if args.shared_prefix:
         out = run_prefix_bench(shared_prefix=args.shared_prefix,
                                waves=args.prefix_waves,
-                               cache_dir=args.cache_dir)
+                               cache_dir=args.cache_dir,
+                               attribution=args.attribution)
         line = {"metric": "prefix_ttft_p99_speedup",
                 "value": out.get("prefix_ttft_p99_speedup"),
                 "unit": "x"}

@@ -97,6 +97,10 @@ def main(argv=None):
         os.environ[DIR_ENV] = args.kvtier_dir
     from ..observability import trace as _trace
     _trace.adopt_env()
+    # flight recorder: adopt the supervisor's per-replica persist dir
+    # and stamp timelines with this replica's stable id
+    from ..observability.flight import configure_from_env
+    configure_from_env(replica=args.replica_id)
 
     from ..serving import InferenceServer
     server = InferenceServer(

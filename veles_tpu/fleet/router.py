@@ -66,6 +66,7 @@ from ..httpjson import JsonRequestHandler
 from ..kvtier import PREFIX_HEADER, PrefixDirectory
 from ..logger import events
 from ..observability import trace as _trace
+from ..observability.flight import RECORDER as _flight
 from ..observability.registry import REGISTRY
 
 #: connection-level failures that mark a replica down and allow the
@@ -191,6 +192,11 @@ class _RouterHandler(JsonRequestHandler):
                 urllib.parse.urlparse(self.path).query)
             key = (query.get("key") or [None])[0]
             self.send_json(200, router.fleet_kv(key))
+        elif path == "/fleet/requests":
+            query = urllib.parse.parse_qs(
+                urllib.parse.urlparse(self.path).query)
+            rid = (query.get("id") or [None])[0]
+            self.send_json(200, router.fleet_requests(rid))
         else:
             self.send_json(404, {"error": "not found"})
 
@@ -656,6 +662,10 @@ class FleetRouter:
         sid = handler.headers.get("X-Session-Id") or None
         if sid:
             headers["X-Session-Id"] = sid
+        tenant = handler.headers.get("X-Veles-Tenant")
+        if tenant:
+            headers["X-Veles-Tenant"] = tenant
+            _flight.annotate(ctx.trace_id, tenant=tenant)
         deadline = self._parse_deadline(handler)
         tried = []
         retried = False
@@ -664,6 +674,9 @@ class FleetRouter:
         prefer = self._session_home(sid) if sid else None
         if prefer is None:
             prefer = self._affinity_pick(handler)
+            if prefer is not None:
+                _flight.record(ctx.trace_id, "router.affinity",
+                               replica=prefer)
         rep = None
         while True:
             if deadline is not None:
@@ -672,6 +685,8 @@ class FleetRouter:
                     # shed BEFORE a replica spends device time on an
                     # answer nobody is waiting for
                     self._c_expired.inc()
+                    _flight.anomaly(ctx.trace_id, "deadline_504")
+                    _flight.finish(ctx.trace_id, status="deadline_504")
                     handler.send_json(
                         504, {"error": "deadline expired"},
                         headers=_trace.http_headers(ctx))
@@ -686,6 +701,9 @@ class FleetRouter:
                 rep = self.pick(exclude=tried, prefer=prefer)
             if rep is None:
                 self._c_no_replica.inc()
+                _flight.anomaly(ctx.trace_id, "shed_429",
+                                detail="no_replica")
+                _flight.finish(ctx.trace_id, status="no_replica")
                 handler.send_json(
                     503, {"error": "no ready replica"},
                     headers={"Retry-After": "1",
@@ -693,6 +711,8 @@ class FleetRouter:
                 return 503, None, retried
             tried.append(rep.id)
             prefer = None
+            _flight.record(ctx.trace_id, "router.dispatch",
+                           replica=rep.id, attempt=len(tried))
             try:
                 status, resp_headers, data = self._forward(
                     rep, path, body, headers, handler)
@@ -708,6 +728,9 @@ class FleetRouter:
                 self._c_truncated.labels(replica=rep.id).inc()
                 if len(tried) < self._retry_budget():
                     self._c_retry.labels(replica=rep.id).inc()
+                    _flight.record(ctx.trace_id, "router.retry",
+                                   replica=rep.id, reason="truncated")
+                    _flight.anomaly(ctx.trace_id, "retry")
                     retried = True
                     continue
                 break
@@ -721,6 +744,9 @@ class FleetRouter:
                 self.mark_down(rep.id)
                 if len(tried) < self._retry_budget():
                     self._c_retry.labels(replica=rep.id).inc()
+                    _flight.record(ctx.trace_id, "router.retry",
+                                   replica=rep.id, reason="connection")
+                    _flight.anomaly(ctx.trace_id, "recovery_replay")
                     retried = True
                     continue
                 break
@@ -738,6 +764,9 @@ class FleetRouter:
                 # home and re-attach — one answer, no client redirect
                 follows += 1
                 self._c_follow.inc()
+                _flight.record(
+                    ctx.trace_id, "router.follow", session=sid,
+                    target=lower.get("x-veles-session-target"))
                 sid = moved
                 headers["X-Session-Id"] = sid
                 attach = True
@@ -751,7 +780,12 @@ class FleetRouter:
                 self.note_session_home(sid, rep.id)
             if data is not _STREAMED:
                 self._respond(handler, status, resp_headers, data)
+            _flight.finish(ctx.trace_id,
+                           status="ok" if status < 400
+                           else "status_%d" % status)
             return status, rep.id, retried
+        _flight.anomaly(ctx.trace_id, "error", detail="dispatch_failed")
+        _flight.finish(ctx.trace_id, status="dispatch_failed")
         handler.send_json(502, {"error": "dispatch failed on %d "
                                 "replicas" % len(tried),
                                 "replicas": tried},
@@ -816,6 +850,44 @@ class FleetRouter:
         return {"replicas": self.prefix_directory.snapshot(max_keys=64),
                 "affinity_hits": int(self._c_aff_hit.value),
                 "affinity_fallbacks": int(self._c_aff_fallback.value)}
+
+    def fleet_requests(self, trace_id=None):
+        """The ``GET /fleet/requests`` payload: flight-recorder
+        timelines merged across the router and every live replica,
+        grouped by trace id — one request's full cross-process story
+        (tools/request_inspect.py renders it).  With ``id=``, only
+        that trace."""
+        path = "/api/requests"
+        if trace_id:
+            path += "?id=" + urllib.parse.quote(str(trace_id))
+        merged = {}
+
+        def _absorb(source, timelines):
+            for tl in timelines or ():
+                tid = tl.get("trace_id") if isinstance(tl, dict) \
+                    else None
+                if not tid:
+                    continue
+                tl.setdefault("replica", source)
+                merged.setdefault(tid, []).append(tl)
+
+        _absorb("router", _flight.snapshot(trace_id=trace_id))
+        with self._lock:
+            reps = list(self._replicas.values())
+        stats = {"router": _flight.stats()}
+        for rep in reps:
+            if not rep.up:
+                continue
+            try:
+                _, body = get_json(rep.host, rep.port, path,
+                                   timeout=2.0)
+            except _DISPATCH_ERRORS + (ValueError,):
+                continue
+            if not isinstance(body, dict):
+                continue
+            _absorb(rep.id, body.get("requests"))
+            stats[rep.id] = body.get("flight")
+        return {"requests": merged, "flight": stats}
 
     def merged_metrics(self):
         """Router counters + every live replica's own /metrics + the

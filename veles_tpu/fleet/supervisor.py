@@ -80,7 +80,7 @@ class ReplicaSupervisor:
     def __init__(self, models, replicas=2, router=None, *,
                  host="127.0.0.1", max_batch=64, queue_limit=256,
                  workers=1, cache_dir=None, kvtier_dir=None,
-                 python=None, env=None,
+                 flight_dir=None, python=None, env=None,
                  backoff=None, spawn_timeout=180.0, poll_interval=0.1,
                  fault_plans=None, clock=time.monotonic):
         items = models.items() if hasattr(models, "items") else models
@@ -92,6 +92,7 @@ class ReplicaSupervisor:
         self.workers = int(workers)
         self.cache_dir = cache_dir
         self.kvtier_dir = kvtier_dir
+        self.flight_dir = flight_dir
         self.python = python or sys.executable
         self.spawn_timeout = float(spawn_timeout)
         self.poll_interval = float(poll_interval)
@@ -125,6 +126,13 @@ class ReplicaSupervisor:
             # surviving chains (the chaos drill's warm-restart invariant)
             env["VELES_KVTIER_DIR"] = os.path.join(
                 str(self.kvtier_dir), rid)
+        if self.flight_dir and rid is not None:
+            # per-replica flight-record dir: anomalous request
+            # timelines persist here and SURVIVE a SIGKILL — the
+            # chaos drill's evidence trail (tools/request_inspect.py
+            # --dir reads them offline)
+            env["VELES_FLIGHT_DIR"] = os.path.join(
+                str(self.flight_dir), rid)
         plan = self.fault_plans.get(rid) if rid is not None else None
         if plan is not None:
             env["VELES_FAULT_PLAN"] = (plan if isinstance(plan, str)

@@ -98,6 +98,12 @@ class EventLog:
         self._file = None
         self._lock = threading.Lock()
         self.path = None
+        #: optional in-process mirror (the flight recorder's span
+        #: bridge): called as ``sink(name, kind, duration, info)``
+        #: BEFORE the enabled gate, so per-request timelines work even
+        #: when file tracing is off.  Exceptions are swallowed —
+        #: observability never takes down the caller.
+        self.span_sink = None
         # perf_counter, not time.time(): a wall-clock jump (NTP step,
         # suspend/resume) must never produce out-of-order or
         # negative-duration trace events
@@ -134,7 +140,14 @@ class EventLog:
         atexit.register(self.close)
 
     def event(self, name, kind="single", duration=None, **info):
-        """Record one event; no-op unless tracing is enabled."""
+        """Record one event; no-op unless tracing is enabled (the
+        ``span_sink`` mirror fires regardless — it is memory-only)."""
+        sink = self.span_sink
+        if sink is not None:
+            try:
+                sink(name, kind, duration, info)
+            except Exception:  # noqa: BLE001 — diagnostics never raise
+                pass
         if not self.enabled:
             return
         ctx = _trace.current()

@@ -35,4 +35,10 @@ def __getattr__(name):
     if name == "profiler":
         from . import profiler
         return profiler
+    if name in ("flight", "attribution"):
+        import importlib
+        return importlib.import_module("." + name, __name__)
+    if name == "FlightRecorder":
+        from .flight import FlightRecorder
+        return FlightRecorder
     raise AttributeError(name)

@@ -142,6 +142,7 @@ class Metric:
         self.label_names = tuple(label_names)
         self.buckets = tuple(buckets) if buckets else None
         self._children = {}
+        self._default_child = None
         self._lock = threading.Lock()
 
     def labels(self, **kv):
@@ -165,11 +166,17 @@ class Metric:
         return child
 
     # label-less convenience: the metric itself acts as its only child
+    # (child cached: inc() sits on serving hot paths, and labels()
+    # rebuilds the key tuple + set-compares on every call)
     def _default(self):
-        if self.label_names:
-            raise ValueError("%s has labels %r; use .labels(...)"
-                             % (self.name, self.label_names))
-        return self.labels()
+        child = self._default_child
+        if child is None:
+            if self.label_names:
+                raise ValueError("%s has labels %r; use .labels(...)"
+                                 % (self.name, self.label_names))
+            child = self.labels()
+            self._default_child = child
+        return child
 
     def inc(self, amount=1):
         self._default().inc(amount)

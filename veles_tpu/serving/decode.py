@@ -70,6 +70,7 @@ import numpy
 from ..compilecache import WarmupManifest, default_cache
 from ..logger import events
 from ..observability import trace as _trace
+from ..observability.flight import RECORDER as _flight
 from .kvcache import KVBlockPool, key_chain, required_blocks
 from .metrics import DecodeMetrics
 from .scheduler import (DeadlineExpired, SchedulerClosed,
@@ -89,6 +90,12 @@ DEFAULT_PREFILL_CHUNK = 32
 #: hand-picked speculation depth (draft tokens per iteration) — the
 #: ``serving.spec_depth`` autotune site's baseline candidate
 DEFAULT_SPEC_DEPTH = 2
+
+
+def _tid(req):
+    """The request's flight-timeline key (its trace id), or None for
+    trace-less direct submits — the recorder ignores None keys."""
+    return req.trace.trace_id if req.trace is not None else None
 
 
 class _Request:
@@ -270,6 +277,7 @@ class DecodeScheduler:
         self._kvtier = self._resolve_kvtier(kvtier)
         self._advert = None          # {"hbm": [...], "host": [...], ...}
         self._advert_sig = None
+        self._readmit_bytes = 0      # wire bytes of the last tier readmit
         if self._kvtier is not None:
             self._pool.on_evict = self._demote_block
             self._refresh_advert()   # disk chains advertise pre-traffic
@@ -569,6 +577,9 @@ class DecodeScheduler:
             self._depth += 1
         req = _Request(prompt, max_new_tokens, session_id=session_id,
                        deadline=deadline)
+        _flight.record(_tid(req), "queue.enter", model=self.name,
+                       session=req.sid,
+                       prompt_tokens=int(prompt.shape[0]))
         self._queue.put(req)
         return req.future
 
@@ -691,6 +702,8 @@ class DecodeScheduler:
             self._pending.popleft()
             row = rows.pop(0)
             session = _Session(req, row, blocks)
+            _flight.record(_tid(req), "queue.admit", row=row,
+                           chunked=False)
             try:
                 self._prefill(session)
             except Exception as exc:  # noqa: BLE001 — fail THIS request
@@ -718,7 +731,7 @@ class DecodeScheduler:
         the rest as private blocks, queue the session for chunk steps.
         Returns False when the pool cannot serve it yet."""
         length = len(req.prompt)
-        matched, tier_hit = [], None
+        matched, tier_hit, tier_s = [], None, 0.0
         if self.prefix_caching:
             # never match the whole prompt: the first output token
             # needs the hidden state at position length-1, which only
@@ -729,8 +742,11 @@ class DecodeScheduler:
             hbm_matched = self._pool.acquire_prefix(keys)
             matched = list(hbm_matched)
             if self._kvtier is not None and len(matched) < len(keys):
+                self._readmit_bytes = 0
+                t_tier = time.perf_counter()
                 matched, tier_hit = self._extend_from_tiers(keys,
                                                             matched)
+                tier_s = time.perf_counter() - t_tier
             if tier_hit is None and matched:
                 tier_hit = "hbm"
         private = self._pool.alloc(need - len(matched))
@@ -744,6 +760,15 @@ class DecodeScheduler:
         session.shared = len(matched)
         session.tier = tier_hit
         session.prefilled = len(matched) * self.block_size
+        tid = _tid(req)
+        _flight.record(tid, "queue.admit", row=row, chunked=True,
+                       prefix_blocks=len(matched))
+        if matched:
+            _flight.record(tid, "tier.hit", tier=tier_hit,
+                           blocks=len(matched),
+                           readmit_bytes=(self._readmit_bytes
+                                          if tier_s else 0),
+                           seconds=round(tier_s, 6))
         # the page-table row stays zeroed (trash) until the final chunk
         # lands: decode steps must treat this row as padding, and a
         # stray write must never touch a shared block
@@ -790,6 +815,9 @@ class DecodeScheduler:
         self.metrics.record_chunk()
         events.span("serving.prefill_chunk", dt, model=self.name,
                     start=int(start), prompt_tokens=int(length))
+        _flight.record(_tid(req), "prefill.chunk",
+                       seconds=round(dt, 6), start=int(start),
+                       end=int(end), prompt_tokens=int(length))
         if end < length:
             self._chunking.append(session)
             return
@@ -807,6 +835,10 @@ class DecodeScheduler:
             session.first_token_s,
             resident=session.shared * self.block_size / length,
             tier=session.tier)
+        _flight.record(_tid(req), "first_token",
+                       ttft_s=round(session.first_token_s, 6),
+                       resident_blocks=session.shared,
+                       tier=session.tier)
         self._publish_prompt(session)
         if session.done:            # max_new_tokens == 1
             self._retire(session)
@@ -917,6 +949,7 @@ class DecodeScheduler:
             if found is None:
                 break
             tier, data = found
+            self._readmit_bytes += len(data)
             alloc = self._pool.alloc(1)
             if alloc is None:
                 break                # pool full: prefill the rest
@@ -999,6 +1032,12 @@ class DecodeScheduler:
         self.metrics.record_first_token(session.first_token_s)
         events.span("serving.prefill", dt, model=self.name,
                     bucket=int(bucket), prompt_tokens=int(length))
+        tid = _tid(req)
+        _flight.record(tid, "prefill.chunk", seconds=round(dt, 6),
+                       start=0, end=int(length),
+                       prompt_tokens=int(length))
+        _flight.record(tid, "first_token",
+                       ttft_s=round(session.first_token_s, 6))
 
     # -- the per-token step --------------------------------------------------
     def _step(self):
@@ -1016,6 +1055,7 @@ class DecodeScheduler:
             time.sleep(delay)
         dt = time.perf_counter() - t0
         active = list(self._sessions.values())
+        step_rows = []
         for session in active:
             token = int(next_tokens[session.row])
             session.length += 1              # the fed token is now cached
@@ -1023,8 +1063,13 @@ class DecodeScheduler:
             session.next_input = token
             self._np_lengths[session.row] = session.length
             self._np_tokens[session.row] = token
+            step_rows.append((_tid(session.req),
+                              len(session.generated)))
             if session.done:
                 self._retire(session)
+        # one lock acquisition for the whole batch; the fair per-row
+        # share (dt / active rows) is computed inside
+        _flight.record_step_rows(step_rows, dt)
         self.metrics.record_step(len(active), self.max_batch, dt)
 
     def _spec_step(self):
@@ -1072,6 +1117,8 @@ class DecodeScheduler:
         vdt = time.perf_counter() - t1
         active = list(self._sessions.values())
         accepted_total = emitted_total = 0
+        draft_share = ddt / max(len(active), 1)
+        verify_share = vdt / max(len(active), 1)
         for session in active:
             row = session.row
             accepted = 0
@@ -1092,6 +1139,11 @@ class DecodeScheduler:
             self._np_tokens[row] = session.next_input
             accepted_total += accepted
             emitted_total += len(emit)
+            _flight.record(_tid(session.req), "spec.step",
+                           step=len(session.generated), drafted=k,
+                           accepted=accepted, emitted=len(emit),
+                           draft_share_s=round(draft_share, 6),
+                           verify_share_s=round(verify_share, 6))
             if session.done:
                 self._retire(session)
         rejected_total = len(active) * k - accepted_total
@@ -1116,13 +1168,31 @@ class DecodeScheduler:
         self._np_lengths[session.row] = 0
         self._np_tokens[session.row] = 0
         future = session.req.future
+        tid = _tid(session.req)
         if error is not None:
             self.metrics.record_complete(len(session.generated),
                                          ok=False)
+            _flight.record(tid, "retire",
+                           tokens=len(session.generated),
+                           error=type(error).__name__)
+            _flight.anomaly(tid, "error",
+                            error=type(error).__name__)
+            _flight.finish(tid, status="error")
             if future.set_running_or_notify_cancel():
                 future.set_exception(error)
         else:
             self.metrics.record_complete(len(session.generated))
+            tokens = len(session.generated)
+            per_token = None
+            if session.first_token_s is not None and tokens > 1:
+                total_s = time.perf_counter() - session.req.enqueued
+                per_token = max(0.0, total_s - session.first_token_s) \
+                    / (tokens - 1)
+            _flight.record(tid, "retire", tokens=tokens,
+                           session=session.req.sid)
+            _flight.finish(tid, status="ok",
+                           ttft_s=session.first_token_s,
+                           per_token_s=per_token)
             result = {
                 "tokens": [int(t) for t in session.generated],
                 "prompt_tokens": len(session.req.prompt),
@@ -1421,12 +1491,25 @@ class DecodeScheduler:
         return states
 
     def _fresh_state(self, req):
-        return {"session_id": req.sid,
-                "prompt": numpy.array(req.prompt),
-                "max_new_tokens": int(req.max_new_tokens),
-                "block_size": self.block_size,
-                "deadline_left_s": None if req.deadline is None
-                else max(req.deadline - time.monotonic(), 0.0)}
+        # the timeline travels WITH the migrated session (migration is
+        # an anomaly trigger and a hop the destination must attribute),
+        # stitched by the trace id the destination re-adopts
+        tid = _tid(req)
+        state = {"session_id": req.sid,
+                 "prompt": numpy.array(req.prompt),
+                 "max_new_tokens": int(req.max_new_tokens),
+                 "block_size": self.block_size,
+                 "deadline_left_s": None if req.deadline is None
+                 else max(req.deadline - time.monotonic(), 0.0)}
+        if tid:
+            _flight.record(tid, "migrate.export", session=req.sid,
+                           model=self.name)
+            _flight.anomaly(tid, "migration")
+            state["trace_id"] = tid
+            timeline = _flight.export(tid)
+            if timeline is not None:
+                state["flight"] = timeline
+        return state
 
     def _export_one(self, session):
         req = session.req
@@ -1485,6 +1568,16 @@ class DecodeScheduler:
             deadline = time.monotonic() + float(state["deadline_left_s"])
         req = _Request(prompt, state["max_new_tokens"],
                        session_id=sid, deadline=deadline)
+        # continue the ORIGINAL trace: the imported session's decode
+        # steps must land in the same flight timeline the source
+        # exported (one trace id end-to-end across the migration hop)
+        tid = state.get("trace_id")
+        if tid:
+            req.trace = _trace.SpanContext(str(tid), _trace.new_id(),
+                                           None)
+            _flight.absorb(state.get("flight"))
+            _flight.record(str(tid), "migrate.import", session=sid,
+                           model=self.name)
         # the parked future, when this is a source-side abort/restore —
         # the original waiter stays attached through the round trip
         parked = self._migrating.pop(sid, None)

@@ -35,6 +35,7 @@ import numpy
 from ..compilecache import WarmupManifest, default_cache
 from ..logger import events
 from ..observability import trace as _trace
+from ..observability.flight import RECORDER as _flight
 from .metrics import ServingMetrics
 
 
@@ -414,6 +415,9 @@ class BucketScheduler:
                     % (self._depth, self.queue_limit))
             self._depth += 1
         req = _Pending(x, deadline)
+        if req.trace is not None:
+            _flight.record(req.trace.trace_id, "queue.enter",
+                           model=self.name, rows=int(x.shape[0]))
         self._queue.put(req)
         return req.future
 
@@ -521,12 +525,22 @@ class BucketScheduler:
                 r.future.set_result(out[off:off + r.n])
             off += r.n
         self._release(len(batch))
+        dt = time.perf_counter() - t0
         # request span ids riding this batch (bounded: a full 64-batch
         # of tiny requests must not bloat every span record)
         links = [r.trace.span_id for r in batch
                  if r.trace is not None][:16] or None
-        self.metrics.record_batch(bucket, rows,
-                                  time.perf_counter() - t0, len(batch),
+        # per-request flight share: batch cost split by row count, so
+        # co-batched requests attribute the device time fairly
+        for r in batch:
+            if r.trace is not None:
+                _flight.record(r.trace.trace_id, "queue.admit",
+                               bucket=int(bucket))
+                _flight.record(r.trace.trace_id, "batch.execute",
+                               seconds=round(dt * r.n / max(rows, 1),
+                                             6),
+                               bucket=int(bucket), rows=int(rows))
+        self.metrics.record_batch(bucket, rows, dt, len(batch),
                                   links=links)
 
     def _release(self, n):

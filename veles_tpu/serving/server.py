@@ -41,6 +41,7 @@ import json
 import logging
 import threading
 import time
+import urllib.parse
 import uuid
 from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import ThreadingHTTPServer
@@ -48,6 +49,7 @@ from http.server import ThreadingHTTPServer
 from ..httpjson import ClientError, JsonRequestHandler
 from ..logger import events
 from ..observability import trace as _trace
+from ..observability.flight import RECORDER as _flight
 from .registry import ModelRegistry
 from .scheduler import (DeadlineExpired, SchedulerClosed,
                         SchedulerOverflow, deadline_expired)
@@ -124,6 +126,17 @@ class _ServingHandler(JsonRequestHandler):
                 self.send_json(200, entry.scheduler.kv_dump())
             except Exception as exc:  # noqa: BLE001 — draining et al.
                 self.send_json(503, {"error": str(exc)})
+        elif path.startswith("/api/") and path.endswith("/requests"):
+            # flight-recorder ring: per-request timelines
+            # (tools/request_inspect.py; the router merges these into
+            # GET /fleet/requests)
+            name = path[len("/api/"):-len("/requests")] or None
+            query = urllib.parse.parse_qs(
+                urllib.parse.urlparse(self.path).query)
+            rid = (query.get("id") or [None])[0]
+            self.send_json(200, {
+                "requests": _flight.snapshot(trace_id=rid, model=name),
+                "flight": _flight.stats()})
         elif path == "/admin/sessions" and srv.enable_admin:
             out = {}
             for name in srv.registry.names():
@@ -315,6 +328,35 @@ class _ServingHandler(JsonRequestHandler):
             self.send_json(500, {"error": "%s failed: %s"
                                  % (action, str(exc)[:300])})
 
+    # -- flight-recorder lifecycle -------------------------------------------
+    def _flight_open(self, ctx, name, path):
+        """Open (or continue) the request's flight timeline: the trace
+        id is the stitching key, the tenant tag rides as metadata."""
+        tid = ctx.trace_id
+        _flight.annotate(tid, model=name or "<default>",
+                         tenant=self.headers.get("X-Veles-Tenant"))
+        _flight.record(tid, "request.recv", path=path,
+                       model=name or "<default>")
+
+    def _flight_close(self, ctx, status):
+        """Close the timeline with the anomaly triggers the response
+        status implies (shed/deadline/server fault).  A 200 decode
+        response was already finished by the scheduler's retire; a 307
+        stays open — the destination replica finishes it."""
+        tid = ctx.trace_id
+        _flight.record(tid, "request.done", status=int(status))
+        if status == 429:
+            _flight.anomaly(tid, "shed_429")
+            _flight.finish(tid, status="shed_429")
+        elif status == 504:
+            _flight.anomaly(tid, "deadline_504")
+            _flight.finish(tid, status="deadline_504")
+        elif status >= 500:
+            _flight.anomaly(tid, "error", status=int(status))
+            _flight.finish(tid, status="error_%d" % status)
+        elif status == 200:
+            _flight.finish(tid, status="ok")
+
     # -- the inference path --------------------------------------------------
     def _infer(self, name):
         # request → batch → executable causality: the request runs in a
@@ -324,9 +366,11 @@ class _ServingHandler(JsonRequestHandler):
         with _trace.span_context(
                 trace_id=self.headers.get("X-Trace-Id") or None) as ctx:
             t0 = time.perf_counter()
+            self._flight_open(ctx, name, "infer")
             status = self._infer_traced(name, ctx)
             events.span("serving.request", time.perf_counter() - t0,
                         model=name or "<default>", status=status)
+            self._flight_close(ctx, status)
 
     def _infer_traced(self, name, ctx):
         """The request body; returns the HTTP status it answered."""
@@ -396,10 +440,12 @@ class _ServingHandler(JsonRequestHandler):
         with _trace.span_context(
                 trace_id=self.headers.get("X-Trace-Id") or None) as ctx:
             t0 = time.perf_counter()
+            self._flight_open(ctx, name, "generate")
             status = self._generate_traced(name, ctx)
             events.span("serving.generate_request",
                         time.perf_counter() - t0,
                         model=name or "<default>", status=status)
+            self._flight_close(ctx, status)
 
     def _read_generate_payload(self):
         """{"prompt": [...], "max_new_tokens": n?, "session_id": s?}
