@@ -916,6 +916,61 @@ def bench_speculative(probe_timeout=300):
     return out
 
 
+def bench_quantized(probe_timeout=300):
+    """Quantized serving (ISSUE 18 acceptance: int8 KV pools hold
+    >= 2x the concurrent sessions of f32 at a FIXED pool byte budget
+    and beat its decode tok/s, with flagship logit RMSE <= 1e-2 and
+    every emitted sequence bitwise-equal to the oracle; warm restart
+    of the int8 config compiles nothing including the dtype-tagged
+    executables).  Cold/warm probe pair like the decode stage: two
+    fresh subprocesses sharing one cache dir, the second IS the
+    restart."""
+    import subprocess
+    import tempfile
+    _stamp("quantized stage")
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "serve_bench.py")
+    cache_dir = os.path.join(
+        tempfile.mkdtemp(prefix="veles-quant-bench-"), "compile_cache")
+
+    def probe(tag):
+        argv = [sys.executable, tool, "--kv-dtype", "f32,int8",
+                "--json", "--cache-dir", cache_dir]
+        proc = subprocess.run(argv, capture_output=True,
+                              timeout=probe_timeout)
+        line = _last_json_line(proc.stdout.decode())
+        if line is None:
+            raise RuntimeError("quant probe (%s) failed: %s"
+                               % (tag, proc.stderr.decode()[-400:]))
+        _stamp("quant %s: sessions %sx, tok/s %sx, rmse %s, "
+               "match=%s, %s post-warmup compiles"
+               % (tag, line.get("quant_session_ratio"),
+                  line.get("quant_speedup"),
+                  line.get("quant_logit_rmse_int8"),
+                  line.get("quant_tokens_match"),
+                  line.get("quant_post_warmup_compiles")))
+        return line
+
+    cold = probe("cold")
+    warm = probe("warm")        # the restart: manifest + cache replay
+    keys = ("quant_pool_bytes", "quant_block_bytes_f32",
+            "quant_block_bytes_int8", "quant_max_sessions_f32",
+            "quant_max_sessions_int8", "quant_session_ratio",
+            "quant_tok_s_f32", "quant_tok_s_int8", "quant_speedup",
+            "quant_logit_rmse_int8", "quant_tokens_match",
+            "quant_token_mismatches", "quant_post_warmup_compiles")
+    out = {k: warm.get(k) for k in keys}
+    out["quant_cold_session_ratio"] = cold.get("quant_session_ratio")
+    out["quant_gate_passed"] = bool(
+        (warm.get("quant_session_ratio") or 0) >= 2.0
+        and (warm.get("quant_speedup") or 0) > 1.0
+        and (warm.get("quant_logit_rmse_int8") or 1e9) <= 1e-2
+        and warm.get("quant_tokens_match"))
+    out["quant_config"] = _autotune_provenance(
+        "serving.kv_dtype", {"max_context": 64})
+    return out
+
+
 def bench_flight_recorder(probe_timeout=420):
     """Flight-recorder overhead gate (ISSUE 17 acceptance: recorder-on
     decode tok/s within 2% of recorder-off, every anomalous request
@@ -1631,6 +1686,8 @@ def _stage_main(stage):
         out = bench_prefix_reuse()
     elif stage == "speculative":
         out = bench_speculative()
+    elif stage == "quantized":
+        out = bench_quantized()
     elif stage == "flight_recorder":
         out = bench_flight_recorder()
     elif stage == "fleet":
@@ -1715,6 +1772,12 @@ STAGE_PLAN = [
     # @draft/@verify executables; three fresh subprocesses over one
     # cache dir
     ("speculative", 360),
+    # quantized serving (ISSUE 18): int8 KV pools vs f32 at a fixed
+    # pool byte budget — >= 2x concurrent sessions, improved tok/s,
+    # flagship logit RMSE <= 1e-2, bitwise oracle tokens, warm restart
+    # compiles == 0 including the dtype-tagged executables; two fresh
+    # subprocesses over one cache dir
+    ("quantized", 420),
     # flight-recorder overhead gate (ISSUE 17): recorder-on vs
     # recorder-off decode tok/s interleaved (< 2% acceptance), one
     # organically captured p99-anomaly timeline, and the shared-prefix

@@ -70,7 +70,8 @@ def test_every_site_default_is_its_own_first_candidate():
             "serving.bucket_ladder": {"max_batch": 16},
             "serving.decode": {"max_context": 64},
             "serving.prefill_chunk": {"max_prompt_len": 64},
-            "serving.spec_depth": {"max_new_tokens": 32}}
+            "serving.spec_depth": {"max_new_tokens": 32},
+            "serving.kv_dtype": {"max_context": 64}}
     assert set(ctxs) == set(space.SITES)
     for name, ctx in ctxs.items():
         sp = space.site(name)

@@ -537,3 +537,86 @@ def test_sigkill_disk_tier_survives_respawn(tmp_path):
         assert again["tokens"] == warm["tokens"], (warm, again)
     finally:
         fleet.stop()
+
+
+# -- quantized replicas under the drill (ISSUE 18) ----------------------------
+
+def test_chaos_drill_int8_replicas_exact_under_faults():
+    """The seeded drill against QUANTIZED serving: both replicas run
+    toydecode with ``kv_dtype=int8`` (token ids stored as int8, so a
+    successful response must be EXACT, not merely within the error
+    bound).  SIGKILL one replica and truncate on the other over an
+    open loop: zero raw failures, every 200 bitwise-matches the f32
+    oracle, and the respawned replica still serves int8 pools."""
+    from veles_tpu.serving import ToyDecodeModel
+    spec = ("toydecode:vocab=64,block=4,max_batch=4,max_prompt=16,"
+            "max_new=8,num_blocks=32,kv_dtype=int8")
+    # three replicas like the f32 drill: a truncated response always
+    # has a live peer to retry on, even inside r0's down window
+    plans = {
+        "r0": {"seed": 5, "rules": [{"at": 8, "action": "sigkill"}]},
+        "r1": {"seed": 6, "rules": [{"every": 9, "action": "truncate",
+                                     "bytes": 20}]},
+        "r2": {"seed": 7, "rules": [{"at": 7, "action": "blackhole",
+                                     "seconds": 1.5}]},
+    }
+    fleet = Fleet({"toy": spec}, replicas=3, poll_interval=0.1,
+                  request_timeout=5, fault_plans=plans,
+                  backoff={"base": 0.1, "factor": 2.0, "cap": 2.0,
+                           "max_restarts": 10}).start(ready_timeout=120)
+    oracle = ToyDecodeModel(vocab=64)
+    prompts = [[3, 1, 2], [9, 8, 7, 6], [5, 5, 5], [1, 2, 3, 4, 5]]
+    counts = {"ok": 0, "shed": 0, "failed": 0, "mismatch": 0}
+    failures = []
+    lock = threading.Lock()
+    stop = time.perf_counter() + 5.0
+
+    def client(idx):
+        prompt = prompts[idx % len(prompts)]
+        want = oracle.generate_reference(prompt, 6)
+        while time.perf_counter() < stop:
+            status, body, err = -1, {}, None
+            for _ in range(10):     # a well-behaved client retries 503
+                try:
+                    status, body, _ = _post(
+                        fleet.url + "/api/toy/generate",
+                        {"prompt": prompt, "max_new_tokens": 6},
+                        timeout=30)
+                except Exception as e:
+                    status, err = -1, e
+                if status != 503:
+                    break
+                time.sleep(0.1)
+            with lock:
+                if status == 200:
+                    counts["ok"] += 1
+                    if body.get("tokens") != want:
+                        counts["mismatch"] += 1
+                elif status in (429, 503):
+                    counts["shed"] += 1
+                else:
+                    counts["failed"] += 1
+                    failures.append((status, err, body))
+    try:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert counts["failed"] == 0, (counts, failures)
+        assert counts["mismatch"] == 0, counts
+        assert counts["ok"] > 10, counts
+        _wait(lambda: fleet.router.ready_count() == 3, timeout=60,
+              what="killed replica to respawn ready")
+        desc = fleet.supervisor.describe()
+        assert desc["r0"]["restarts"] >= 1, desc
+        # the respawn serves int8 again: the quant block rides its dump
+        url = "http://%s:%d/api/toy/kv" % (fleet.supervisor.host,
+                                           desc["r0"]["port"])
+        dump = json.loads(urllib.request.urlopen(
+            url, timeout=10).read())
+        assert dump["kv_dtype"] == "int8", dump.get("kv_dtype")
+        assert dump["quant"]["bytes_per_block"] > 0
+    finally:
+        fleet.stop()

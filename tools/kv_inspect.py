@@ -13,7 +13,9 @@ The decode-serving sibling of ``tools/ckpt_inspect.py``: where that tool
 re-hashes checkpoint chunks on disk, this one reads the scheduler's
 ``GET /api/<model>/kv`` snapshot — resident prefixes with refcounts, the
 refcount-0 LRU cache, dedupe counters, the speculative-decoding
-draft/accept/rollback tallies, and the pool's own invariant check (free + live + shared + cached == capacity, no block in two
+draft/accept/rollback tallies, the quantized-pool ``quant`` block
+(dtype, bytes per block, scale statistics) when the scheduler serves
+``kv_dtype=int8``, and the pool's own invariant check (free + live + shared + cached == capacity, no block in two
 domains, no session referencing an unallocated block).  ``--verify``
 turns any violation into exit code 1, which is how the chaos drill
 (tools/serve_bench.py --chaos) asserts pool integrity on every replica
@@ -103,6 +105,18 @@ def describe(dump):
            dump.get("prefill_chunk_tokens") or "-",
            dump.get("active_sequences", 0),
            dump.get("chunking_sessions", 0)))
+    quant = dump.get("quant")
+    if dump.get("kv_dtype", "f32") != "f32" or quant:
+        scales = (quant or {}).get("scales")
+        lines.append(
+            "  quant: %s pools, %d B/block%s"
+            % (dump.get("kv_dtype", "?"),
+               (quant or {}).get("bytes_per_block", 0),
+               "" if not scales else
+               "; scales min %.3g / mean %.3g / max %.3g "
+               "(%.1f%% zero)"
+               % (scales["min"], scales["mean"], scales["max"],
+                  100.0 * scales.get("zero_fraction", 0.0))))
     lines.append(
         "  reuse: %d hit(s), %d block(s) dedup'd of %d published "
         "(ratio %.2f), %d evicted"

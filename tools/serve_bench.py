@@ -846,6 +846,109 @@ def run_spec_bench(depths=(1, 2, 3, 4), agreement=0.8, n_requests=24,
     return out
 
 
+# -- quantized serving mode ---------------------------------------------------
+
+
+def run_quant_bench(kv_dtypes=("f32", "int8"), pool_bytes=4096,
+                    n_requests=48, max_prompt_len=8, max_new_tokens=16,
+                    block_size=8, step_delay=0.002, rounds=2,
+                    cache_dir=None):
+    """The quantized-serving sweep (ISSUE 18): the SAME request mix
+    served at each candidate KV precision under a FIXED device-byte
+    budget for the pools.  What int8 pools buy is capacity — the same
+    bytes hold ~2-4x the blocks, so more sequences decode concurrently
+    instead of queueing — and with a pinned per-STEP host cost (batch
+    decode's defining property: one step serves every live row), the
+    capacity win is directly a tok/s win.  Every emitted sequence is
+    checked bitwise against the pure-host oracle (the toy model stores
+    token ids, losslessly int8-representable), and the flagship logit
+    RMSE of each precision rides along so the capacity table can never
+    hide an accuracy regression."""
+    import jax
+    import numpy
+    from veles_tpu.autotune.probe import _decode_logit_rmse
+    from veles_tpu.serving import DecodeScheduler
+    from veles_tpu.serving.toydecode import ToyDecodeModel
+    from veles_tpu.znicz.paged_attention import required_blocks
+    from veles_tpu.znicz.samples.flagship import FlagshipDecodeModel
+
+    if cache_dir:
+        from veles_tpu.config import root
+        root.common.compile_cache.dir = cache_dir
+    model = ToyDecodeModel(vocab=64, step_delay=step_delay)
+    requests = _decode_requests(n_requests, max_prompt_len,
+                                max_new_tokens, model.vocab)
+    oracle = [model.generate_reference(p, n) for p, n in requests]
+    flagship = FlagshipDecodeModel(stages=2, experts=2, d=16, heads=2,
+                                   hidden=32, vocab=32, seed=0)
+    per_seq = required_blocks(max_prompt_len + max_new_tokens,
+                              block_size)
+
+    def block_bytes(kvd):
+        pools = model.make_pools(1, block_size, kv_dtype=kvd)
+        return sum(int(numpy.prod(leaf.shape[1:])) * leaf.dtype.itemsize
+                   for leaf in jax.tree_util.tree_leaves(pools))
+
+    out = {"quant_kv_dtypes": [str(d) for d in kv_dtypes],
+           "quant_pool_bytes": int(pool_bytes),
+           "quant_requests": n_requests,
+           "quant_step_delay_s": step_delay,
+           "quant_block_size": block_size}
+    schedulers, sessions = {}, {}
+    for kvd in kv_dtypes:
+        bb = block_bytes(kvd)
+        num_blocks = max(int(pool_bytes) // bb, per_seq + 1)
+        max_sessions = max((num_blocks - 1) // per_seq, 1)
+        sessions[kvd] = max_sessions
+        out["quant_block_bytes_%s" % kvd] = bb
+        out["quant_num_blocks_%s" % kvd] = num_blocks
+        out["quant_max_sessions_%s" % kvd] = max_sessions
+        out["quant_logit_rmse_%s" % kvd] = round(
+            _decode_logit_rmse(flagship, kvd, [3, 1, 2],
+                               max_new_tokens), 6)
+        schedulers[kvd] = DecodeScheduler(
+            model, max_batch=min(max_sessions, 64),
+            block_size=block_size, num_blocks=num_blocks,
+            max_prompt_len=max_prompt_len,
+            max_new_tokens=max_new_tokens, queue_limit=4096,
+            kv_dtype=kvd, name="quant_bench_%s" % kvd)
+    try:
+        # correctness first (also the untimed warm pass): every
+        # sequence from every precision must match the oracle bitwise
+        mismatches = 0
+        for s in schedulers.values():
+            _tok, _dt, results = _run_continuous(s, requests)
+            mismatches += sum(1 for r, want in zip(results, oracle)
+                              if r["tokens"] != want)
+        out["quant_token_mismatches"] = mismatches
+        out["quant_tokens_match"] = mismatches == 0
+        warm = {d: s.stats()["compiles"]
+                for d, s in schedulers.items()}
+        acc = {d: {"tokens": 0, "t": 0.0} for d in schedulers}
+        for _ in range(max(1, rounds)):    # interleaved: drift cancels
+            for d, s in schedulers.items():
+                tok, dt, _res = _run_continuous(s, requests)
+                acc[d]["tokens"] += tok
+                acc[d]["t"] += dt
+        for d in schedulers:
+            out["quant_tok_s_%s" % d] = round(
+                acc[d]["tokens"] / acc[d]["t"], 1)
+        if "f32" in schedulers and "int8" in schedulers:
+            out["quant_session_ratio"] = round(
+                sessions["int8"] / sessions["f32"], 2)
+            f32_rate = acc["f32"]["tokens"] / acc["f32"]["t"]
+            int8_rate = acc["int8"]["tokens"] / acc["int8"]["t"]
+            out["quant_speedup"] = round(int8_rate / f32_rate, 2) \
+                if f32_rate else None
+        out["quant_post_warmup_compiles"] = sum(
+            s.stats()["compiles"] - warm[d]
+            for d, s in schedulers.items())
+    finally:
+        for s in schedulers.values():
+            s.close(drain=True)
+    return out
+
+
 # -- fleet load mode ----------------------------------------------------------
 #
 # The multi-replica counterpart (ISSUE 7): the SAME open/closed-loop
@@ -1457,6 +1560,15 @@ def main(argv=None):
     p.add_argument("--spec-agree", type=float, default=0.8,
                    help="drafter agreement rate for the --spec-depth "
                         "sweep (0..1; the acceptance-rate dial)")
+    p.add_argument("--kv-dtype", default=None, metavar="D[,D2,...]",
+                   help="quantized serving sweep: the same request mix "
+                        "at each listed KV precision (f32,int8) under "
+                        "a fixed pool byte budget — capacity, tok/s "
+                        "and flagship logit RMSE per precision")
+    p.add_argument("--pool-bytes", type=int, default=4096,
+                   help="device byte budget for the KV pools in the "
+                        "--kv-dtype sweep (both precisions get the "
+                        "same budget; int8 fits more blocks in it)")
     p.add_argument("--cache-dir", default=None,
                    help="persistent executable cache dir (decode mode; "
                         "run twice to prove the zero-recompile warm "
@@ -1600,6 +1712,33 @@ def main(argv=None):
                      out.get("fleet_respawn_compiles"),
                      out.get("fleet_rollout_failed"),
                      out.get("fleet_rollout_s")), file=sys.stderr)
+        print(json.dumps(line))
+        return 0
+
+    if args.kv_dtype:
+        out = run_quant_bench(
+            kv_dtypes=tuple(d.strip() for d in
+                            args.kv_dtype.split(",") if d.strip()),
+            pool_bytes=args.pool_bytes, cache_dir=args.cache_dir)
+        line = {"metric": "quant_session_ratio",
+                "value": out.get("quant_session_ratio"), "unit": "x"}
+        line.update(out)
+        if not args.json:
+            cols = ", ".join(
+                "%s %s tok/s (%s sessions, rmse %s)"
+                % (d, out.get("quant_tok_s_%s" % d),
+                   out.get("quant_max_sessions_%s" % d),
+                   out.get("quant_logit_rmse_%s" % d))
+                for d in out["quant_kv_dtypes"])
+            print("quant bench: %s at %d pool bytes; session ratio "
+                  "%sx, speedup %sx, oracle match=%s, %s post-warmup "
+                  "compiles"
+                  % (cols, out["quant_pool_bytes"],
+                     out.get("quant_session_ratio"),
+                     out.get("quant_speedup"),
+                     out.get("quant_tokens_match"),
+                     out.get("quant_post_warmup_compiles")),
+                  file=sys.stderr)
         print(json.dumps(line))
         return 0
 

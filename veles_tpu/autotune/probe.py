@@ -426,6 +426,94 @@ def probe_spec_depth(config, ctx, reps, windows):
             "cand_s": cand_s, "ref_s": ref_s}
 
 
+def _decode_logit_rmse(model, kv_dtype, prompt, n_new):
+    """Greedy-rollout logit RMSE of ``kv_dtype`` pools vs f32 pools —
+    same params, same geometry, token-by-token through the model's
+    ``logits_fn`` decode hook.  The error-bound gate's measurement."""
+    import jax.numpy as jnp
+    import numpy
+    bs = 4
+    # the fixed geometry below holds 4 blocks x 4 tokens per row —
+    # cap the rollout so no position ever lands past the page table
+    n_new = min(int(n_new), 4 * bs - len(prompt))
+    per = {}
+    for kvd in dict.fromkeys(("f32", kv_dtype)):
+        kp, vp = model.make_pools(8, bs, kv_dtype=kvd)
+        toks = jnp.zeros(8, jnp.int32).at[:len(prompt)].set(
+            jnp.asarray(prompt, jnp.int32))
+        block_row = jnp.asarray([1, 2, 3, 4], jnp.int32)
+        tok, kp, vp = model.prefill_fn(bs, kv_dtype=kvd)(
+            toks, len(prompt), kp, vp, block_row)
+        table = jnp.zeros((2, 4), jnp.int32).at[0].set(block_row)
+        lengths = jnp.asarray([len(prompt), 0], jnp.int32)
+        logits = model.logits_fn(bs, kv_dtype=kvd)
+        cur = jnp.asarray([int(tok), 0], jnp.int32)
+        rows = []
+        for _ in range(n_new):
+            nxt, kp, vp, lg = logits(kp, vp, table, lengths, cur)
+            rows.append(numpy.asarray(lg[0]))
+            lengths = lengths.at[0].add(1)
+            cur = cur.at[0].set(nxt[0])
+        per[kvd] = numpy.stack(rows)
+    if kv_dtype == "f32":
+        return 0.0
+    diff = per[kv_dtype] - per["f32"]
+    return float(numpy.sqrt(numpy.mean(diff * diff)))
+
+
+def probe_kv_dtype(config, ctx, reps, windows):
+    """Decode drain time with the candidate KV-pool precision — what
+    quantized pools buy is HBM (more resident blocks per byte) and
+    memory-bound step time — gated on the site's DECLARED error bound:
+    a lossy candidate cannot be bitwise vs the f32 oracle, so the gate
+    is greedy-rollout logit RMSE <= error_bound, measured through the
+    model's ``logits_fn`` hook before any timing."""
+    import numpy
+    from veles_tpu.autotune.space import site
+    from veles_tpu.serving import DecodeScheduler
+    from veles_tpu.znicz.samples.flagship import FlagshipDecodeModel
+    sp = site("serving.kv_dtype")
+    bound = float(ctx.get("error_bound", sp.error_bound))
+    max_prompt = int(ctx.get("max_prompt_len", 8))
+    max_new = int(ctx.get("max_new_tokens", 8))
+    n_requests = int(ctx.get("requests", 8))
+    kvd = str(config["kv_dtype"])
+    model = FlagshipDecodeModel(stages=2, experts=2, d=16, heads=2,
+                                hidden=32, vocab=32, seed=0)
+    rng = numpy.random.RandomState(int(ctx.get("seed", 0)))
+    prompts = [[int(t) for t in rng.randint(
+        0, 32, size=rng.randint(1, max_prompt + 1))]
+        for _ in range(n_requests)]
+    rmse = _decode_logit_rmse(model, kvd, prompts[0][:3] or [1],
+                              max_new)
+
+    def build(kv_dtype, tag):
+        return DecodeScheduler(
+            model, max_batch=4, block_size=4,
+            max_prompt_len=max_prompt, max_new_tokens=max_new,
+            queue_limit=4 * n_requests, warmup=True, cache=False,
+            kv_dtype=kv_dtype, name="autotune-kv-%s" % tag)
+
+    cand = build(kvd, kvd)
+    ref = build(sp.default["kv_dtype"], "ref")
+    try:
+        def drain(s):
+            futs = [s.submit(p, max_new) for p in prompts]
+            return [f.result(120) for f in futs]
+
+        drain(cand)
+        cand_s, ref_s = _timed_pair(lambda: drain(cand),
+                                    lambda: drain(ref), reps, windows)
+    finally:
+        cand.close(drain=False)
+        ref.close(drain=False)
+    return {"gate": _gate(rmse <= bound,
+                          "logit_rmse=%.3g > bound=%.3g"
+                          % (rmse, bound)),
+            "logit_rmse": round(rmse, 6), "error_bound": bound,
+            "cand_s": cand_s, "ref_s": ref_s}
+
+
 _IMPLS = {
     "lrn": probe_lrn,
     "flash_attention": probe_flash_attention,
@@ -436,13 +524,16 @@ _IMPLS = {
     "serving.decode": probe_serving_decode,
     "serving.prefill_chunk": probe_prefill_chunk,
     "serving.spec_depth": probe_spec_depth,
+    "serving.kv_dtype": probe_kv_dtype,
 }
 
 #: cheap serving probes need fewer reps than μs-scale kernels
 _DEFAULT_REPS = {"serving.bucket_ladder": 1, "serving.decode": 1,
-                 "serving.prefill_chunk": 1, "serving.spec_depth": 1}
+                 "serving.prefill_chunk": 1, "serving.spec_depth": 1,
+                 "serving.kv_dtype": 1}
 _DEFAULT_WINDOWS = {"serving.bucket_ladder": 2, "serving.decode": 2,
-                    "serving.prefill_chunk": 2, "serving.spec_depth": 2}
+                    "serving.prefill_chunk": 2, "serving.spec_depth": 2,
+                    "serving.kv_dtype": 2}
 
 
 def main(argv=None):

@@ -7,7 +7,7 @@ same: the site declares *every* candidate up front with validity
 constraints, the runner measures, and only a measured, correctness-
 gated winner is ever persisted.
 
-Nine builtin sites cover the tree's tunables:
+Ten builtin sites cover the tree's tunables:
 
 ==================== ======================================== ===========
 site                 parameters                               dispatch at
@@ -21,6 +21,7 @@ serving.bucket_ladder shape (pow2|coarse|dense)               serving/scheduler.
 serving.decode       max_batch, block_size                    serving/decode.py
 serving.prefill_chunk chunk_tokens                            serving/decode.py
 serving.spec_depth   spec_depth                               serving/decode.py
+serving.kv_dtype     kv_dtype (f32|int8)                      serving/decode.py
 ==================== ======================================== ===========
 
 Every site's ``default`` is the exact hand-picked configuration the
@@ -88,16 +89,25 @@ class SearchSpace:
     reported against).  ``constraint(config, ctx)`` filters the cross
     product; ``classify(ctx)`` maps a concrete call context to the
     shape-class string the tuning store keys on.
+
+    ``error_bound`` declares the numeric tolerance a LOSSY candidate
+    must stay within to pass the probe's gate (e.g. logit RMSE for
+    quantized KV pools).  ``None`` — every site that searches exact
+    reformulations — keeps the gate bitwise/exact: an error bound is a
+    property of the site's contract, declared here, never improvised
+    per probe run.
     """
 
     def __init__(self, name, params, default, constraint=None,
-                 classify=None, description=""):
+                 classify=None, description="", error_bound=None):
         self.name = name
         self.params = {k: tuple(v) for k, v in params.items()}
         self.default = dict(default)
         self._constraint = constraint
         self._classify = classify
         self.description = description
+        self.error_bound = (None if error_bound is None
+                            else float(error_bound))
 
     def valid(self, config, ctx=None):
         if set(config) != set(self.params):
@@ -273,6 +283,19 @@ _register(SearchSpace(
     description="speculative decoding depth: draft tokens per "
                 "iteration — measured acceptance rate vs the "
                 "multi-token verify pass's cost"))
+
+
+_register(SearchSpace(
+    "serving.kv_dtype",
+    params={"kv_dtype": ("f32", "int8")},
+    default={"kv_dtype": "f32"},     # decode pools exactly as shipped
+    classify=lambda ctx: "ctx%d" % pow2_bucket(
+        ctx.get("max_context", 64)),
+    error_bound=1e-2,
+    description="KV-pool precision: f32 pools exactly as shipped, or "
+                "int8 blocks dequantized in-kernel — the first lossy "
+                "site, gated on the declared logit-RMSE bound instead "
+                "of bitwise equality"))
 
 
 def site(name):

@@ -171,7 +171,7 @@ class DecodeScheduler:
                  queue_limit=64, name="decode", metrics=None,
                  cache=None, manifest=None, warmup=True,
                  prefix_caching=False, prefill_chunk_tokens=None,
-                 spec_depth=None, kvtier=None):
+                 spec_depth=None, kvtier=None, kv_dtype=None):
         self.name = name
         self.model = model
         self.max_prompt_len = int(max_prompt_len)
@@ -233,6 +233,35 @@ class DecodeScheduler:
                 "model %r has no draft_fn/verify_fn; speculative "
                 "decoding is unavailable for it"
                 % getattr(model, "name", model))
+        # KV-pool precision is a TUNABLE SITE too (serving.kv_dtype):
+        # a string pins it, "auto" consults the tuning store (whose
+        # probe is error-bounded, not bitwise), None (default) keeps
+        # the f32 pools and every model call byte-identical
+        self._kv_dtype_source = None
+        kvd = kv_dtype
+        if kvd == "auto":
+            from ..autotune import dispatch as _autotune
+            cfg_q, self._kv_dtype_source = _autotune.resolve(
+                "serving.kv_dtype", "ctx%d" % self.max_context,
+                default={"kv_dtype": "f32"})
+            kvd = cfg_q["kv_dtype"]
+        elif kvd is not None:
+            self._kv_dtype_source = "explicit"
+        self.kv_dtype = str(kvd) if kvd else "f32"
+        if self.kv_dtype != "f32":
+            supported = tuple(getattr(model, "kv_dtypes", ("f32",)))
+            if self.kv_dtype not in supported:
+                raise ValueError(
+                    "model %r does not serve kv_dtype=%r "
+                    "(supported: %s)"
+                    % (getattr(model, "name", model), self.kv_dtype,
+                       ", ".join(supported)))
+        # quantized pools widen the model-hook signatures ONLY when
+        # on: the f32 default calls every factory exactly as before
+        self._model_kw = ({} if self.kv_dtype == "f32"
+                          else {"kv_dtype": self.kv_dtype})
+        self._tag_sfx = ("" if self.kv_dtype == "f32"
+                         else "-" + self.kv_dtype)
         # the decode geometry is a TUNABLE SITE (serving.decode):
         # explicit kwargs pin it; otherwise a tuning record for this
         # context-length class picks the measured (max_batch,
@@ -282,7 +311,7 @@ class DecodeScheduler:
             self._pool.on_evict = self._demote_block
             self._refresh_advert()   # disk chains advertise pre-traffic
         self._k_pools, self._v_pools = model.make_pools(
-            num_blocks, self.block_size)
+            num_blocks, self.block_size, **self._model_kw)
         # numpy mirrors of the step operands; the worker edits them on
         # admit/retire and ships them whole every step
         self._np_table = numpy.zeros((self.max_batch, self.max_blocks),
@@ -304,23 +333,37 @@ class DecodeScheduler:
         # split (fresh compiles vs cache hits) as BucketScheduler
         import jax
         self._jax = jax
-        self._decode_jit = jax.jit(model.decode_fn(self.block_size),
-                                   donate_argnums=(0, 1))
-        self._prefill_jit = jax.jit(model.prefill_fn(self.block_size),
-                                    donate_argnums=(2, 3))
+        # static per-block byte footprint across every pool leaf (int8
+        # pools carry their f32 scale planes — both leaves index blocks
+        # on axis 0, so shape[1:] is exactly the per-block payload)
+        self._block_bytes = sum(
+            int(numpy.prod(leaf.shape[1:])) * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(
+                (self._k_pools, self._v_pools)))
+        self.metrics.set_kv_dtype(self.kv_dtype)
+        self.metrics.set_kv_bytes(0)
+        self._decode_jit = jax.jit(
+            model.decode_fn(self.block_size, **self._model_kw),
+            donate_argnums=(0, 1))
+        self._prefill_jit = jax.jit(
+            model.prefill_fn(self.block_size, **self._model_kw),
+            donate_argnums=(2, 3))
         self._chunk_jit = None
         if self.chunk_tokens:
             self._chunk_jit = jax.jit(
-                model.prefill_chunk_fn(self.block_size),
+                model.prefill_chunk_fn(self.block_size,
+                                       **self._model_kw),
                 donate_argnums=(3, 4))
         self._draft_jit = self._verify_jit = None
         if self.spec_depth:
             # the drafter only READS the pools (no donation — the
             # verify pass reuses them); verify donates like decode
             self._draft_jit = jax.jit(
-                model.draft_fn(self.block_size, self.spec_depth))
+                model.draft_fn(self.block_size, self.spec_depth,
+                               **self._model_kw))
             self._verify_jit = jax.jit(
-                model.verify_fn(self.block_size, self.spec_depth),
+                model.verify_fn(self.block_size, self.spec_depth,
+                                **self._model_kw),
                 donate_argnums=(0, 1))
         self._decode_exe = None
         self._chunk_exe = None
@@ -363,6 +406,11 @@ class DecodeScheduler:
             self._manifest.record_config(
                 self.name, "serving.spec_depth",
                 {"spec_depth": self.spec_depth})
+        if self._manifest is not None \
+                and self._kv_dtype_source == "tuned":
+            self._manifest.record_config(
+                self.name, "serving.kv_dtype",
+                {"kv_dtype": self.kv_dtype})
         self._warmed = False
         if warmup:
             self.warmup()
@@ -411,7 +459,8 @@ class DecodeScheduler:
                                              numpy.int32),
                         jax.ShapeDtypeStruct((self.max_batch,),
                                              numpy.int32),
-                        tag="decode%d" % self.max_batch)
+                        tag="decode%d%s" % (self.max_batch,
+                                            self._tag_sfx))
                     if self._manifest is not None:
                         self._manifest.record(self.name + "@decode",
                                               self.max_batch)
@@ -433,7 +482,8 @@ class DecodeScheduler:
                         kps, vps,
                         jax.ShapeDtypeStruct((self.max_blocks,),
                                              numpy.int32),
-                        tag="prefill%d" % int(bucket))
+                        tag="prefill%d%s" % (int(bucket),
+                                             self._tag_sfx))
                     self._prefill_exes[bucket] = exe
                     if self._manifest is not None:
                         self._manifest.record(self.name + "@prefill",
@@ -454,7 +504,8 @@ class DecodeScheduler:
                                              numpy.int32),
                         jax.ShapeDtypeStruct((self.max_batch,),
                                              numpy.int32),
-                        tag="draft%d" % self.spec_depth)
+                        tag="draft%d%s" % (self.spec_depth,
+                                           self._tag_sfx))
                     if self._manifest is not None:
                         self._manifest.record(self.name + "@draft",
                                               self.spec_depth)
@@ -475,7 +526,8 @@ class DecodeScheduler:
                         jax.ShapeDtypeStruct(
                             (self.max_batch, self.spec_depth + 1),
                             numpy.int32),
-                        tag="verify%d" % self.spec_depth)
+                        tag="verify%d%s" % (self.spec_depth,
+                                            self._tag_sfx))
                     if self._manifest is not None:
                         self._manifest.record(self.name + "@verify",
                                               self.spec_depth)
@@ -496,7 +548,8 @@ class DecodeScheduler:
                         kps, vps,
                         jax.ShapeDtypeStruct((self.max_blocks,),
                                              numpy.int32),
-                        tag="chunk%d" % self.chunk_tokens)
+                        tag="chunk%d%s" % (self.chunk_tokens,
+                                           self._tag_sfx))
                     if self._manifest is not None:
                         self._manifest.record(self.name + "@chunk",
                                               self.chunk_tokens)
@@ -579,7 +632,11 @@ class DecodeScheduler:
                        deadline=deadline)
         _flight.record(_tid(req), "queue.enter", model=self.name,
                        session=req.sid,
-                       prompt_tokens=int(prompt.shape[0]))
+                       prompt_tokens=int(prompt.shape[0]),
+                       kv_dtype=self.kv_dtype)
+        # meta too (events don't feed aggregate()'s group keys): the
+        # attribution report can slice tail latency by pool precision
+        _flight.annotate(_tid(req), kv_dtype=self.kv_dtype)
         self._queue.put(req)
         return req.future
 
@@ -666,6 +723,16 @@ class DecodeScheduler:
                 future.set_exception(exc)
 
     # -- admission / prefill -------------------------------------------------
+    def _set_occupancy(self):
+        self.metrics.set_occupancy(
+            len(self._sessions), self._pool.live_blocks /
+            max(self._pool.capacity, 1))
+        # cached (refcount-0, content retained for prefix reuse) blocks
+        # still hold device bytes — resident means "not free"
+        self.metrics.set_kv_bytes(
+            (self._pool.live_blocks + self._pool.cached_blocks)
+            * self._block_bytes)
+
     def _free_rows(self):
         busy = set(self._sessions)
         busy.update(s.row for s in self._chunking)
@@ -719,9 +786,7 @@ class DecodeScheduler:
                 self._retire(session)
                 rows.insert(0, row)
         self.metrics.set_chunk_queue(len(self._chunking))
-        self.metrics.set_occupancy(
-            len(self._sessions), self._pool.live_blocks /
-            max(self._pool.capacity, 1))
+        self._set_occupancy()
         if self._kvtier is not None:
             self._refresh_advert()
 
@@ -736,9 +801,9 @@ class DecodeScheduler:
             # never match the whole prompt: the first output token
             # needs the hidden state at position length-1, which only
             # a prefill of >= 1 suffix token computes
-            keys = key_chain(req.prompt,
-                             self.block_size)[:(length - 1) //
-                                              self.block_size]
+            keys = key_chain(req.prompt, self.block_size,
+                             kv_dtype=self.kv_dtype)[:(length - 1) //
+                                                     self.block_size]
             hbm_matched = self._pool.acquire_prefix(keys)
             matched = list(hbm_matched)
             if self._kvtier is not None and len(matched) < len(keys):
@@ -851,7 +916,8 @@ class DecodeScheduler:
         already match them."""
         if not self.prefix_caching:
             return
-        keys = key_chain(session.req.prompt, self.block_size)
+        keys = key_chain(session.req.prompt, self.block_size,
+                         kv_dtype=self.kv_dtype)
         for i, key in enumerate(keys):
             block = session.blocks[i]
             if not self._pool.is_shared(block):
@@ -864,7 +930,8 @@ class DecodeScheduler:
         history (prompt + generated) — a multi-turn follow-up that
         re-submits this conversation attaches to them."""
         history = list(session.req.prompt) + session.generated[:-1]
-        keys = key_chain(history, self.block_size)
+        keys = key_chain(history, self.block_size,
+                         kv_dtype=self.kv_dtype)
         for i, key in enumerate(keys):
             if i >= len(session.blocks):
                 break
@@ -1253,6 +1320,7 @@ class DecodeScheduler:
                 "max_new_tokens": self.max_new_tokens,
                 "num_blocks": self._pool.num_blocks,
                 "prefix_caching": self.prefix_caching,
+                "kv_dtype": self.kv_dtype,
             },
             "k_pools": self._k_pools,
             "v_pools": self._v_pools,
@@ -1283,7 +1351,15 @@ class DecodeScheduler:
                 "restore_kv into a busy scheduler (restore before "
                 "serving traffic)")
         state = load_state(path)
-        geo = state["geometry"]
+        geo = dict(state["geometry"])
+        # dtype first, and by name: restoring int8 blocks into f32
+        # pools (or vice versa) would silently reinterpret quantized
+        # bytes — refuse with the reason, not a generic geometry diff
+        ck_dtype = str(geo.pop("kv_dtype", "f32"))
+        if ck_dtype != self.kv_dtype:
+            raise ValueError(
+                "kv_dtype mismatch: checkpoint holds %s pools but "
+                "this scheduler serves %s" % (ck_dtype, self.kv_dtype))
         mine = {"max_batch": self.max_batch,
                 "block_size": self.block_size,
                 "max_prompt_len": self.max_prompt_len,
@@ -1317,9 +1393,7 @@ class DecodeScheduler:
             with self._depth_lock:
                 self._depth += 1
             futures[session.row] = req.future
-        self.metrics.set_occupancy(
-            len(self._sessions), self._pool.live_blocks /
-            max(self._pool.capacity, 1))
+        self._set_occupancy()
         return futures
 
     # -- live session migration ----------------------------------------------
@@ -1416,6 +1490,9 @@ class DecodeScheduler:
                 resident[tier] = sorted(str(k)[:12] for k in keys)
             tiers["resident"] = resident
             dump["kvtier"] = tiers
+        dump["kv_dtype"] = self.kv_dtype
+        if self.kv_dtype != "f32":
+            dump["quant"] = self._quant_stats()
         dump.update(model=self.name,
                     prefill_chunk_tokens=self.chunk_tokens,
                     active_sequences=len(self._sessions),
@@ -1436,6 +1513,35 @@ class DecodeScheduler:
                 "rolled_back_tokens": self._pool.rolled_back_tokens,
             }
         return dump
+
+    def _quant_stats(self):
+        """The ``quant`` block of :meth:`kv_dump`: per-block byte
+        footprint plus scale statistics over the pools' f32 scale
+        planes — how hot the quantization grid runs.  A zero scale
+        marks a never-written (or wiped) block slice, so the stats
+        cover the nonzero entries and report the zero fraction."""
+        scales = []
+        def visit(leaf):
+            if isinstance(leaf, dict) and "s" in leaf:
+                scales.append(numpy.asarray(leaf["s"]))
+            return leaf
+        self._jax.tree_util.tree_map(
+            visit, (self._k_pools, self._v_pools),
+            is_leaf=lambda x: isinstance(x, dict))
+        out = {"kv_dtype": self.kv_dtype,
+               "bytes_per_block": int(self._block_bytes)}
+        if scales:
+            flat = numpy.concatenate([s.reshape(-1) for s in scales])
+            nz = flat[flat > 0]
+            if nz.size:
+                out["scales"] = {
+                    "min": float(nz.min()),
+                    "max": float(nz.max()),
+                    "mean": float(nz.mean()),
+                    "zero_fraction": round(
+                        1.0 - nz.size / flat.size, 4),
+                }
+        return out
 
     def spill_session(self, session_id, directory):
         """Spill one (idle) session to a host-side sharded checkpoint
@@ -1499,6 +1605,7 @@ class DecodeScheduler:
                  "prompt": numpy.array(req.prompt),
                  "max_new_tokens": int(req.max_new_tokens),
                  "block_size": self.block_size,
+                 "kv_dtype": self.kv_dtype,
                  "deadline_left_s": None if req.deadline is None
                  else max(req.deadline - time.monotonic(), 0.0)}
         if tid:
@@ -1583,6 +1690,17 @@ class DecodeScheduler:
         parked = self._migrating.pop(sid, None)
         if parked is not None:
             req.future = parked
+        # prompt-only states carry no KV bytes, so they import under
+        # ANY pool dtype; states with device bytes must match — int8
+        # payloads scattered into f32 pools would be garbage
+        if state.get("kv_k") is not None \
+                and str(state.get("kv_dtype", "f32")) != self.kv_dtype:
+            if parked is not None:
+                self._migrating[sid] = parked
+            raise ValueError(
+                "kv_dtype mismatch: session %s travels %s KV blocks "
+                "but this scheduler serves %s"
+                % (sid, state.get("kv_dtype", "f32"), self.kv_dtype))
         if state.get("kv_k") is None:       # prompt-only: just enqueue
             self._pending.append(req)
             with self._depth_lock:
@@ -1653,9 +1771,7 @@ class DecodeScheduler:
         with self._depth_lock:
             self._depth += 1
         self.metrics.record_migrate(1, "in")
-        self.metrics.set_occupancy(
-            len(self._sessions), self._pool.live_blocks /
-            max(self._pool.capacity, 1))
+        self._set_occupancy()
         return sid
 
     def _release_migrated(self, session_ids, target):
@@ -1808,6 +1924,11 @@ class DecodeScheduler:
             "num_blocks": pool["num_blocks"],
             "free_blocks": pool["free_blocks"],
             "kv_utilization": pool["utilization"],
+            "kv_dtype": self.kv_dtype,
+            "block_bytes": int(self._block_bytes),
+            "kv_bytes_resident": int(
+                (self._pool.live_blocks + self._pool.cached_blocks)
+                * self._block_bytes),
             "max_prompt_len": self.max_prompt_len,
             "max_new_tokens": self.max_new_tokens,
             "prefix_caching": self.prefix_caching,
@@ -1818,6 +1939,8 @@ class DecodeScheduler:
         }
         if self._chunk_source is not None:
             out["chunk_source"] = self._chunk_source
+        if self._kv_dtype_source is not None:
+            out["kv_dtype_source"] = self._kv_dtype_source
         if self.spec_depth:
             drafted = self._spec_drafted
             out.update(

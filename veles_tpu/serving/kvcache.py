@@ -53,17 +53,26 @@ def required_blocks(tokens, block_size):
     return -(-int(tokens) // int(block_size))
 
 
-def key_chain(tokens, block_size):
+def key_chain(tokens, block_size, kv_dtype="f32"):
     """Rolling content keys of every FULL block of ``tokens``.
 
     ``keys[i] = sha256(keys[i-1] + tokens_of_block_i)`` — a block's key
     commits to the entire prefix ending at that block, so two sequences
     share ``keys[i]`` iff their first ``(i+1) * block_size`` tokens are
     identical.  Trailing partial blocks get no key (they are still
-    being written)."""
+    being written).
+
+    ``kv_dtype != "f32"`` mixes the precision into the chain seed:
+    quantization is deterministic (same tokens in, same int8 bytes +
+    scales out), so tagging the seed is equivalent to hashing the
+    quantized bytes themselves — equal tags + equal tokens imply equal
+    block content — while guaranteeing an int8 chain can never dedupe
+    against an f32 chain whose device bytes differ."""
     bs = int(block_size)
     toks = [int(t) for t in tokens]
-    keys, parent = [], b"veles-kv"
+    keys = []
+    parent = (b"veles-kv" if kv_dtype == "f32"
+              else b"veles-kv/" + kv_dtype.encode())
     for i in range(len(toks) // bs):
         h = hashlib.sha256(parent)
         h.update(b",".join(b"%d" % t for t in toks[i * bs:(i + 1) * bs]))

@@ -399,6 +399,21 @@ class DecodeMetrics:
             "veles_serving_kv_blocks_used_ratio",
             "Live KV blocks / allocatable blocks", ("model",)).labels(
                 model=model)
+        # quantized-serving gauges: byte footprint of the live blocks
+        # (int8 pools shrink it ~4x at the same block count — THE
+        # concurrent-sessions-at-fixed-HBM win) and the pool dtype as
+        # an info gauge so dashboards can slice tok/s by precision
+        self._g_kv_bytes = self.registry.gauge(
+            "veles_decode_kv_bytes_resident",
+            "Device bytes held by resident (live + prefix-cached) KV "
+            "blocks; quantized pools shrink this at the same block "
+            "count",
+            ("model",)).labels(model=model)
+        self._g_kv_dtype = self.registry.gauge(
+            "veles_decode_kv_dtype_info",
+            "KV-pool element dtype serving this model (info gauge: "
+            "value 1 on the active dtype label)",
+            ("model", "kv_dtype"))
         self._g_quantile = self.registry.gauge(
             "veles_serving_decode_step_quantile_ms",
             "Exact decode-step quantiles over the recent window",
@@ -559,6 +574,13 @@ class DecodeMetrics:
     def set_occupancy(self, active_rows, kv_ratio):
         self._g_active.set(int(active_rows))
         self._g_kv.set(float(kv_ratio))
+
+    def set_kv_bytes(self, nbytes):
+        self._g_kv_bytes.set(int(nbytes))
+
+    def set_kv_dtype(self, kv_dtype):
+        self._g_kv_dtype.labels(model=self.model,
+                                kv_dtype=str(kv_dtype)).set(1)
 
     def collect_metrics(self):
         """Scrape-time refresh of the derived quantile gauges."""

@@ -156,7 +156,7 @@ class ModelRegistry:
                            "queue_limit", "cache", "manifest",
                            "warmup", "prefix_caching",
                            "prefill_chunk_tokens", "spec_depth",
-                           "kvtier")}
+                           "kvtier", "kv_dtype")}
         # a model may carry its own geometry (the toydecode spec path):
         # registry-wide defaults < model defaults < explicit kwargs
         kwargs.update(getattr(model, "decode_defaults", None) or {})

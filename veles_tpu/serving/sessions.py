@@ -41,7 +41,10 @@ HASH_FIELD = "kv_hash"
 
 def _encode(value):
     if isinstance(value, numpy.ndarray):
-        a = numpy.ascontiguousarray(value)
+        # asarray(order="C"), NOT ascontiguousarray: the latter
+        # promotes 0-d arrays to (1,), which breaks the shape-exact
+        # round trip quantized scale leaves (a scalar per block) need
+        a = numpy.asarray(value, order="C")
         return {_ND: base64.b64encode(a.tobytes()).decode("ascii"),
                 "dtype": str(a.dtype), "shape": list(a.shape)}
     if isinstance(value, (list, tuple)):

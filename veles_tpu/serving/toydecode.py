@@ -58,9 +58,19 @@ class ToyDecodeModel:
     """Decode adapter (``make_pools``/``prefill_fn``/``decode_fn``)
     whose K pool caches the token ids and whose V pool caches
     ``3*token+1`` — the next token is a function of both sums, so the
-    output is a fingerprint of the cache contents."""
+    output is a fingerprint of the cache contents.
+
+    ``kv_dtype="int8"`` swaps each int32 pool for {"q": int8, "s": f32
+    per-block scales} leaves (the flagship pool layout, 4x fewer KV
+    bytes).  Both pools then store the raw token id — lossless for
+    vocab <= 128, with ``3*token+1`` computed at read time and scales
+    pinned at 1.0 — so int8 outputs are still EXACTLY the host
+    oracle's, keeping every migration/chaos token-identity check as
+    strict as in f32."""
 
     kind = "decode"
+    #: KV-cache precisions the factories accept (scheduler protocol)
+    kv_dtypes = ("f32", "int8")
 
     def __init__(self, vocab=97, step_delay=0.0, prefill_delay=0.0,
                  decode_defaults=None, draft_agreement=1.0,
@@ -85,16 +95,33 @@ class ToyDecodeModel:
         # (registry defaults < these < explicit kwargs)
         self.decode_defaults = dict(decode_defaults or {})
 
-    def make_pools(self, num_blocks, block_size):
+    def _kv(self, kv_dtype):
+        dt = "f32" if kv_dtype is None else kv_dtype
+        if dt not in self.kv_dtypes:
+            raise ValueError("kv_dtype=%r not in %r"
+                             % (dt, self.kv_dtypes))
+        if dt == "int8" and self.vocab > 128:
+            raise ValueError(
+                "toydecode kv_dtype='int8' stores token ids as int8, "
+                "so vocab must be <= 128 (got %d)" % self.vocab)
+        return dt
+
+    def make_pools(self, num_blocks, block_size, kv_dtype=None):
         import jax.numpy as jnp
         shape = (int(num_blocks), int(block_size))
+        if self._kv(kv_dtype) == "int8":
+            def pool():
+                return {"q": jnp.zeros(shape, jnp.int8),
+                        "s": jnp.ones((shape[0],), jnp.float32)}
+            return ((pool(),), (pool(),))
         return ((jnp.zeros(shape, jnp.int32),),
                 (jnp.zeros(shape, jnp.int32),))
 
-    def prefill_fn(self, block_size):
+    def prefill_fn(self, block_size, kv_dtype=None):
         import jax.numpy as jnp
         bs = int(block_size)
         vocab = self.vocab
+        q8 = self._kv(kv_dtype) == "int8"
 
         def prefill(tokens, length, k_pools, v_pools, block_row):
             k, v = k_pools[0], v_pools[0]
@@ -104,8 +131,13 @@ class ToyDecodeModel:
             off = pos % bs
             kv_k = jnp.where(valid, tokens, 0)
             kv_v = jnp.where(valid, 3 * tokens + 1, 0)
-            k = k.at[dest, off].set(kv_k)
-            v = v.at[dest, off].set(kv_v)
+            if q8:
+                row = kv_k.astype(jnp.int8)
+                k = dict(k, q=k["q"].at[dest, off].set(row))
+                v = dict(v, q=v["q"].at[dest, off].set(row))
+            else:
+                k = k.at[dest, off].set(kv_k)
+                v = v.at[dest, off].set(kv_v)
             s1 = jnp.sum(kv_k)
             s2 = jnp.sum(kv_v)
             last = tokens[jnp.maximum(length - 1, 0)]
@@ -115,10 +147,11 @@ class ToyDecodeModel:
 
         return prefill
 
-    def prefill_chunk_fn(self, block_size):
+    def prefill_chunk_fn(self, block_size, kv_dtype=None):
         import jax.numpy as jnp
         bs = int(block_size)
         vocab = self.vocab
+        q8 = self._kv(kv_dtype) == "int8"
 
         def chunk(tokens, start, length, k_pools, v_pools, block_row):
             k, v = k_pools[0], v_pools[0]
@@ -127,15 +160,24 @@ class ToyDecodeModel:
             valid = pos < length
             dest = jnp.where(valid, block_row[pos // bs], 0)
             off = pos % bs
-            k = k.at[dest, off].set(jnp.where(valid, tokens, 0))
-            v = v.at[dest, off].set(jnp.where(valid, 3 * tokens + 1, 0))
-            # the sums run over the WHOLE cached prompt gathered
-            # through the block row — the resident prefix is READ, not
-            # recomputed, so a mutated or mis-matched shared block
-            # changes the first token (the COW fingerprint the prefix
-            # tests rely on)
-            flat_k = k[block_row].reshape(-1)
-            flat_v = v[block_row].reshape(-1)
+            if q8:
+                row = jnp.where(valid, tokens, 0).astype(jnp.int8)
+                k = dict(k, q=k["q"].at[dest, off].set(row))
+                v = dict(v, q=v["q"].at[dest, off].set(row))
+                flat_k = k["q"][block_row].reshape(-1)\
+                    .astype(jnp.int32)
+                flat_v = 3 * flat_k + 1
+            else:
+                k = k.at[dest, off].set(jnp.where(valid, tokens, 0))
+                v = v.at[dest, off].set(
+                    jnp.where(valid, 3 * tokens + 1, 0))
+                # the sums run over the WHOLE cached prompt gathered
+                # through the block row — the resident prefix is READ,
+                # not recomputed, so a mutated or mis-matched shared
+                # block changes the first token (the COW fingerprint
+                # the prefix tests rely on)
+                flat_k = k[block_row].reshape(-1)
+                flat_v = v[block_row].reshape(-1)
             gpos = jnp.arange(flat_k.shape[0], dtype=jnp.int32)
             mask = gpos < length
             s1 = jnp.sum(jnp.where(mask, flat_k, 0))
@@ -147,10 +189,11 @@ class ToyDecodeModel:
 
         return chunk
 
-    def decode_fn(self, block_size):
+    def decode_fn(self, block_size, kv_dtype=None):
         import jax.numpy as jnp
         bs = int(block_size)
         vocab = self.vocab
+        q8 = self._kv(kv_dtype) == "int8"
 
         def decode(k_pools, v_pools, page_table, lengths, tokens):
             k, v = k_pools[0], v_pools[0]
@@ -159,12 +202,20 @@ class ToyDecodeModel:
             # have lengths 0 and table row 0 → the trash block)
             dest = page_table[rows, lengths // bs]
             off = lengths % bs
-            k = k.at[dest, off].set(tokens)
-            v = v.at[dest, off].set(3 * tokens + 1)
-            # gather each row's cache through ITS page table and mask
-            # by length — exactly the paged-attention access pattern
-            flat_k = k[page_table].reshape(tokens.shape[0], -1)
-            flat_v = v[page_table].reshape(tokens.shape[0], -1)
+            if q8:
+                row = tokens.astype(jnp.int8)
+                k = dict(k, q=k["q"].at[dest, off].set(row))
+                v = dict(v, q=v["q"].at[dest, off].set(row))
+                flat_k = k["q"][page_table]\
+                    .reshape(tokens.shape[0], -1).astype(jnp.int32)
+                flat_v = 3 * flat_k + 1
+            else:
+                k = k.at[dest, off].set(tokens)
+                v = v.at[dest, off].set(3 * tokens + 1)
+                # gather each row's cache through ITS page table and
+                # mask by length — the paged-attention access pattern
+                flat_k = k[page_table].reshape(tokens.shape[0], -1)
+                flat_v = v[page_table].reshape(tokens.shape[0], -1)
             pos = jnp.arange(flat_k.shape[1], dtype=jnp.int32)[None, :]
             count = lengths + 1          # the fed token is now cached
             mask = pos < count[:, None]
@@ -176,7 +227,7 @@ class ToyDecodeModel:
 
         return decode
 
-    def draft_fn(self, block_size, depth):
+    def draft_fn(self, block_size, depth, kv_dtype=None):
         """Drafter: propose ``depth`` tokens per row by replaying the
         recurrence forward from the cache sums — pure reads, the pools
         are never written.  Proposals are deterministically corrupted
@@ -187,11 +238,17 @@ class ToyDecodeModel:
         depth = int(depth)
         vocab = self.vocab
         agree_cut = int(round(self.draft_agreement * _AGREE_MOD))
+        q8 = self._kv(kv_dtype) == "int8"
 
         def draft(k_pools, v_pools, page_table, lengths, tokens):
             k, v = k_pools[0], v_pools[0]
-            flat_k = k[page_table].reshape(tokens.shape[0], -1)
-            flat_v = v[page_table].reshape(tokens.shape[0], -1)
+            if q8:
+                flat_k = k["q"][page_table]\
+                    .reshape(tokens.shape[0], -1).astype(jnp.int32)
+                flat_v = 3 * flat_k + 1
+            else:
+                flat_k = k[page_table].reshape(tokens.shape[0], -1)
+                flat_v = v[page_table].reshape(tokens.shape[0], -1)
             pos = jnp.arange(flat_k.shape[1], dtype=jnp.int32)[None, :]
             mask = pos < lengths[:, None]
             s1 = jnp.sum(jnp.where(mask, flat_k, 0), axis=1)
@@ -213,7 +270,7 @@ class ToyDecodeModel:
 
         return draft
 
-    def verify_fn(self, block_size, depth):
+    def verify_fn(self, block_size, depth, kv_dtype=None):
         """Target verify: write all ``depth + 1`` fed tokens (the next
         input plus the drafts), then compute the recurrence at EVERY
         fed position — ``out[:, i]`` is the plain-decode next token
@@ -226,6 +283,7 @@ class ToyDecodeModel:
         import jax.numpy as jnp
         bs = int(block_size)
         vocab = self.vocab
+        q8 = self._kv(kv_dtype) == "int8"
 
         def verify(k_pools, v_pools, page_table, lengths, tokens):
             k, v = k_pools[0], v_pools[0]
@@ -238,10 +296,18 @@ class ToyDecodeModel:
                              page_table[rows, jnp.minimum(pos // bs,
                                                           nb - 1)], 0)
             off = pos % bs
-            k = k.at[dest, off].set(tokens)
-            v = v.at[dest, off].set(3 * tokens + 1)
-            flat_k = k[page_table].reshape(b, -1)
-            flat_v = v[page_table].reshape(b, -1)
+            if q8:
+                row = tokens.astype(jnp.int8)
+                k = dict(k, q=k["q"].at[dest, off].set(row))
+                v = dict(v, q=v["q"].at[dest, off].set(row))
+                flat_k = k["q"][page_table].reshape(b, -1)\
+                    .astype(jnp.int32)
+                flat_v = 3 * flat_k + 1
+            else:
+                k = k.at[dest, off].set(tokens)
+                v = v.at[dest, off].set(3 * tokens + 1)
+                flat_k = k[page_table].reshape(b, -1)
+                flat_v = v[page_table].reshape(b, -1)
             gpos = jnp.arange(flat_k.shape[1],
                               dtype=jnp.int32)[None, None, :]
             count = pos + 1              # cache size at each position
@@ -315,13 +381,21 @@ def from_spec(spec):
                     True if v == "1" else v
         elif key == "tier_disk_bytes":
             defaults.setdefault("kvtier", {})["disk_bytes"] = int(value)
+        elif key == "kv_dtype":
+            v = value.strip()
+            if v not in ToyDecodeModel.kv_dtypes:
+                raise ValueError("toydecode kv_dtype=%r (want one of "
+                                 "%s)" % (v, ", ".join(
+                                     ToyDecodeModel.kv_dtypes)))
+            if v != "f32":
+                defaults["kv_dtype"] = v
         elif key in _GEOM_KEYS:
             defaults[_GEOM_KEYS[key]] = int(value)
         else:
             raise ValueError("unknown toydecode spec key %r (want "
                              "vocab, delay, pdelay, ddelay, agree, "
                              "spec, tier_host, tier_disk, "
-                             "tier_disk_bytes, %s)"
+                             "tier_disk_bytes, kv_dtype, %s)"
                              % (key, ", ".join(sorted(_GEOM_KEYS))))
     return ToyDecodeModel(vocab=vocab, step_delay=delay,
                           prefill_delay=pdelay, draft_delay=ddelay,

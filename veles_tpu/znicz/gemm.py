@@ -169,6 +169,154 @@ def _matmul_impl(a, b, level, interpret, block_m=None, block_n=None,
     return out
 
 
+# -- quantized weight GEMM (ISSUE 18) -----------------------------------------
+#
+# Serving-side counterpart of the compensated path above: the weights
+# are static at serve time, so they quantize ONCE (symmetric, one f32
+# scale per output channel) and the kernel streams int8/fp8 bytes from
+# HBM, upcasting each tile in VMEM and folding the channel scales into
+# the output tile after the K loop — scaled accumulation, exact up to
+# the weight quantization itself because per-output-channel scales
+# factor out of the K contraction.
+
+#: largest-magnitude finite value of float8_e4m3fn (the fp8 flavor
+#: jaxlib exposes for storage): per-channel scales target it the way
+#: int8 targets 127
+_FP8_E4M3_MAX = 448.0
+
+
+def fp8_dtype():
+    """The jaxlib's storage fp8 dtype, or None when this jaxlib has
+    none (callers gate the fp8 weight path on this)."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def quantize_weight(w, dtype="int8"):
+    """Symmetric per-output-channel quantization of a ``[K, N]`` weight.
+
+    Returns ``(w_q, scales)``: ``w_q`` in ``dtype`` (``"int8"`` or
+    ``"fp8"``), ``scales`` f32 ``[N]`` with ``scale[n] =
+    max|w[:, n]| / qmax`` (1.0 for an all-zero column).  Because the
+    scale is constant along K, ``x @ dequant(w_q)`` ==
+    ``(x @ upcast(w_q)) * scales`` — which is what lets
+    :func:`quantized_matmul` dequantize AFTER the accumulation.
+    """
+    w = jnp.asarray(w, jnp.float32)
+    if w.ndim != 2:
+        raise ValueError("quantize_weight wants [K, N], got %r"
+                         % (w.shape,))
+    amax = jnp.max(jnp.abs(w), axis=0)
+    if dtype == "int8":
+        scales = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(w / scales[None, :]), -127, 127)
+        return q.astype(jnp.int8), scales.astype(jnp.float32)
+    if dtype == "fp8":
+        f8 = fp8_dtype()
+        if f8 is None:
+            raise ValueError(
+                "this jaxlib exposes no float8 dtype; use dtype='int8'")
+        scales = jnp.where(amax > 0, amax / _FP8_E4M3_MAX, 1.0)
+        return (w / scales[None, :]).astype(f8), \
+            scales.astype(jnp.float32)
+    raise ValueError("unknown weight dtype %r (want 'int8'|'fp8')"
+                     % (dtype,))
+
+
+def quantized_matmul(a, w_q, scales, block_m=None, block_n=None,
+                     block_k=None, interpret=None):
+    """``a @ dequant(w_q)`` with the dequant inside the kernel.
+
+    ``a``: f32 [M, K]; ``w_q``: int8/fp8 [K, N] with f32 ``scales``
+    [N] from :func:`quantize_weight`.  The weight tiles cross HBM in
+    their quantized width; each tile upcasts to f32 in VMEM for the
+    MXU, the accumulator runs plain f32, and the per-channel scales
+    multiply the finished output tile once after the K loop.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _interpret_default()
+    a = jnp.asarray(a, jnp.float32)
+    m, k = a.shape
+    k2, n = w_q.shape
+    if k != k2:
+        raise ValueError("shape mismatch %s @ %s" % (a.shape, w_q.shape))
+    if scales.shape != (n,):
+        raise ValueError("scales shape %r != (N,) == (%d,)"
+                         % (scales.shape, n))
+    bm = min(block_m or DEFAULT_BLOCK_M, m)
+    bn = min(block_n or DEFAULT_BLOCK_N, n)
+    bk = min(block_k or DEFAULT_BLOCK_K, k)
+    pad_m, pad_n, pad_k = (-m) % bm, (-n) % bn, (-k) % bk
+    if pad_m or pad_k:
+        a = jnp.pad(a, ((0, pad_m), (0, pad_k)))
+    if pad_k or pad_n:
+        w_q = jnp.pad(w_q, ((0, pad_k), (0, pad_n)))
+    s2 = jnp.pad(scales.astype(jnp.float32),
+                 (0, pad_n))[None, :]              # [1, N] for blocking
+    grid = (a.shape[0] // bm, w_q.shape[1] // bn, a.shape[1] // bk)
+    k_steps = grid[2]
+
+    def kernel(a_ref, b_ref, s_ref, o_ref, acc_ref):
+        kk = pl.program_id(2)
+
+        @pl.when(kk == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        p = jnp.dot(a_ref[:], b_ref[:].astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)
+        acc_ref[:] = acc_ref[:] + p
+
+        @pl.when(kk == k_steps - 1)
+        def _():
+            o_ref[:] = acc_ref[:] * s_ref[0][None, :]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+                  pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+                  pl.BlockSpec((1, bn), lambda i, j, kk: (0, j))],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct(
+            (a.shape[0], w_q.shape[1]), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams",
+                                        None))(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(a, w_q, s2)
+    if pad_m or pad_n:
+        out = out[:m, :n]
+    return out
+
+
+def quantized_matmul_reference(a, w_q, scales, block_m=None,
+                               block_n=None, block_k=None):
+    """Pure-jnp oracle for :func:`quantized_matmul`, staged the way the
+    kernel accumulates (K-tile-sequential partial products, scales
+    folded after the loop) so parity tests can assert bitwise."""
+    a = jnp.asarray(a, jnp.float32)
+    m, k = a.shape
+    bk = min(block_k or DEFAULT_BLOCK_K, k)
+    pad_k = (-k) % bk
+    if pad_k:
+        a = jnp.pad(a, ((0, 0), (0, pad_k)))
+        w_q = jnp.pad(w_q, ((0, pad_k), (0, 0)))
+    acc = jnp.zeros((m, w_q.shape[1]), jnp.float32)
+    for kk in range(a.shape[1] // bk):
+        sl = slice(kk * bk, (kk + 1) * bk)
+        acc = acc + jnp.dot(a[:, sl],
+                            w_q[sl].astype(jnp.float32),
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.HIGHEST)
+    return acc * scales.astype(jnp.float32)[None, :]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def precise_matmul(a, b, level=1, interpret=None):
     """``a @ b`` with compensated cross-tile accumulation (see module

@@ -35,6 +35,16 @@ not ``B * max_context``.
 Padding rows (``length == 0``) return zeros; padding page-table entries
 must point at physical block 0, which the serving pool reserves as the
 trash block (never allocated to a live sequence).
+
+Quantized pools (ISSUE 18): the same entry points accept int8 K/V
+pools plus per-(block, head) f32 scale arrays (``k_scales``/``v_scales``,
+``[num_blocks]`` — one symmetric scale per PHYSICAL pool block).  The
+scales ride as two extra scalar-prefetch operands and each K/V tile is
+dequantized on the VMEM row right after its DMA (``int8 -> f32 *
+scale[pid]``), so HBM traffic on the hot loop is the int8 bytes; the
+dense-softmax structure, trash-block handling and page-table
+indirection are untouched, and the quantized dense reference stages the
+same dequant elementwise so parity stays bitwise.
 """
 
 import functools
@@ -47,7 +57,8 @@ from jax import lax
 __all__ = ["DEFAULT_BLOCK_SIZE", "paged_attention",
            "paged_attention_reference", "paged_prefill_attention",
            "paged_prefill_attention_reference", "paged_verify_attention",
-           "paged_verify_attention_reference", "required_blocks"]
+           "paged_verify_attention_reference", "required_blocks",
+           "quantize_pool", "dequantize_pool"]
 
 _NEG_INF = float("-inf")
 
@@ -65,6 +76,38 @@ def _interpret():
 def required_blocks(length, block_size):
     """Pool blocks a sequence of ``length`` tokens occupies."""
     return -(-int(length) // int(block_size))
+
+
+def quantize_pool(pool):
+    """Symmetric per-(block, head) int8 quantization of a
+    ``[N, block_size, H, D]`` pool.
+
+    Returns ``(q, scales)`` — ``q`` int8 with the pool's shape,
+    ``scales`` f32 ``[N, H]`` with
+    ``scale[i, h] = max|pool[i, :, h]| / 127`` (1.0 for an all-zero
+    slice, so dequant never divides by zero).  One scale per head, not
+    per block, because head projections differ in magnitude — sharing a
+    scale across heads costs ~2x logit RMSE for zero bytes saved (the
+    scale array is noise next to the pool either way).  The quantizer
+    is deterministic (round-half-even), which is what lets prefix-chain
+    keys commit to the quantized bytes: same content in, same int8
+    bytes out.
+    """
+    if pool.ndim != 4:
+        raise ValueError("expected a [N, block_size, H, D] pool, got "
+                         "shape %r" % (pool.shape,))
+    f = pool.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=(1, 3))      # [N, H]
+    scales = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(f / scales[:, None, :, None]), -127, 127)
+    return q.astype(jnp.int8), scales.astype(jnp.float32)
+
+
+def dequantize_pool(q, scales):
+    """Inverse of :func:`quantize_pool`: ``int8 * scale`` per
+    (block, head)."""
+    return (q.astype(jnp.float32)
+            * scales.astype(jnp.float32)[:, None, :, None])
 
 
 def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
@@ -121,7 +164,94 @@ def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[0] / safe_l).astype(o_ref.dtype)
 
 
-def paged_attention(q, k_pool, v_pool, page_table, lengths, scale=None):
+def _decode_kernel_quant(pt_ref, len_ref, ks_ref, vs_ref, q_ref, k_ref,
+                         v_ref, o_ref, s_scr, m_scr, l_scr, acc_scr, *,
+                         block_size, n_blocks, scale):
+    """The decode kernel over int8 pools: identical sweep/softmax
+    structure, but each K/V tile is dequantized on the VMEM row right
+    after its DMA with the per-(block, head) scale read off the two
+    extra scalar-prefetch operands (``ks_ref``/``vs_ref``, indexed by
+    the PHYSICAL block id the page table routed this grid step to and
+    this grid step's head)."""
+    from jax.experimental import pallas as pl
+
+    b, hh, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    length = len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        s_scr[...] = jnp.full_like(s_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # -- sweep 1: dequantize the K tile, score into the scratch row ----------
+    @pl.when(jnp.logical_and(j < n_blocks, j * block_size < length))
+    def _score():
+        q = q_ref[0, 0].astype(jnp.float32) * scale       # [D]
+        kb = k_ref[0, :, 0].astype(jnp.float32) \
+            * ks_ref[pt_ref[b, j], hh]                    # [bs, D]
+        s = jnp.sum(q[None, :] * kb, axis=-1)             # [bs]
+        pos = j * block_size + lax.broadcasted_iota(
+            jnp.int32, (block_size, 1), 0)[:, 0]
+        s = jnp.where(pos < length, s, _NEG_INF)
+        s_scr[j] = s
+        m_scr[0, 0] = jnp.maximum(m_scr[0, 0], jnp.max(s))
+
+    # -- boundary: dense softmax over the whole scratch row ------------------
+    @pl.when(j == n_blocks)
+    def _normalize():
+        m = m_scr[0, 0]
+        safe_m = jnp.where(jnp.isneginf(m), 0.0, m)
+        p = jnp.where(jnp.isneginf(s_scr[...]), 0.0,
+                      jnp.exp(s_scr[...] - safe_m))
+        s_scr[...] = p
+        l_scr[0, 0] = jnp.sum(p)
+
+    # -- sweep 2: dequantize the V tile, weighted accumulation ---------------
+    jv = j - n_blocks
+
+    @pl.when(jnp.logical_and(j >= n_blocks, jv * block_size < length))
+    def _accumulate():
+        vb = v_ref[0, :, 0].astype(jnp.float32) \
+            * vs_ref[pt_ref[b, jv], hh]                   # [bs, D]
+        p = s_scr[jv]                                     # [bs]
+        acc_scr[...] = acc_scr[...] + jnp.sum(
+            p[:, None] * vb, axis=0, keepdims=True)
+
+    @pl.when(j == 2 * n_blocks - 1)
+    def _finish():
+        l = l_scr[0, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[0] / safe_l).astype(o_ref.dtype)
+
+
+def _check_quant_args(k_pool, v_pool, k_scales, v_scales):
+    """-> True when the pools are quantized (int8 + scales), False for
+    the f32 path; raises on half-specified or mismatched operands."""
+    quantized = k_pool.dtype == jnp.int8
+    if quantized != (v_pool.dtype == jnp.int8):
+        raise ValueError("k_pool/v_pool dtypes differ: %r vs %r"
+                         % (k_pool.dtype, v_pool.dtype))
+    if not quantized:
+        if k_scales is not None or v_scales is not None:
+            raise ValueError(
+                "k_scales/v_scales are only valid with int8 pools "
+                "(got %r pools)" % str(k_pool.dtype))
+        return False
+    if k_scales is None or v_scales is None:
+        raise ValueError("int8 pools require k_scales and v_scales")
+    n_pool, heads = k_pool.shape[0], k_pool.shape[2]
+    for name, s in (("k_scales", k_scales), ("v_scales", v_scales)):
+        if s.shape != (n_pool, heads):
+            raise ValueError(
+                "%s shape %r != (num_blocks, heads) == (%d, %d)"
+                % (name, s.shape, n_pool, heads))
+    return True
+
+
+def paged_attention(q, k_pool, v_pool, page_table, lengths, scale=None,
+                    k_scales=None, v_scales=None):
     """Ragged paged decode attention.
 
     ``q``: [B, H, D] — one query token per sequence;
@@ -130,7 +260,10 @@ def paged_attention(q, k_pool, v_pool, page_table, lengths, scale=None):
     ``page_table``: int32 [B, max_blocks] — physical block id of each
     sequence's logical block, padded with 0 (the reserved trash block);
     ``lengths``: int32 [B] — valid tokens per sequence (0 = padding
-    row, returns zeros).
+    row, returns zeros);
+    ``k_scales``/``v_scales``: f32 [num_blocks, H] — required iff the
+    pools are int8 (per-(block, head) symmetric scales; the kernel
+    dequantizes each tile in VMEM right after its DMA).
 
     Returns [B, H, D].  Compiled once per (B, H, D, block_size,
     max_blocks) — sequence lengths and table contents are runtime data.
@@ -146,8 +279,44 @@ def paged_attention(q, k_pool, v_pool, page_table, lengths, scale=None):
     if (hp, dp) != (h, d):
         raise ValueError("pool head layout %r does not match q %r"
                          % ((hp, dp), (h, d)))
+    quantized = _check_quant_args(k_pool, v_pool, k_scales, v_scales)
     nb = page_table.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    scratch_shapes = [
+        pltpu.VMEM((nb, bs), jnp.float32),    # score / prob row
+        pltpu.VMEM((1, 1), jnp.float32),      # running max
+        pltpu.VMEM((1, 1), jnp.float32),      # softmax denominator
+        pltpu.VMEM((1, d), jnp.float32),      # output accumulator
+    ]
+    if quantized:
+        # the f32 structure with two extra scalar-prefetch operands
+        # (per-block K/V scales) and in-VMEM dequant after each DMA
+        kernel = functools.partial(_decode_kernel_quant, block_size=bs,
+                                   n_blocks=nb, scale=float(scale))
+        k_index = lambda b_, h_, j, pt, ln, ks, vs: (  # noqa: E731
+            pt[b_, jnp.minimum(j, nb - 1)], 0, h_, 0)
+        v_index = lambda b_, h_, j, pt, ln, ks, vs: (  # noqa: E731
+            pt[b_, jnp.clip(j - nb, 0, nb - 1)], 0, h_, 0)
+        q_index = lambda b_, h_, j, pt, ln, ks, vs: (  # noqa: E731
+            b_, h_, 0)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(b, h, 2 * nb),
+            in_specs=[
+                pl.BlockSpec((1, 1, d), q_index),
+                pl.BlockSpec((1, bs, 1, d), k_index),
+                pl.BlockSpec((1, bs, 1, d), v_index),
+            ],
+            out_specs=pl.BlockSpec((1, 1, d), q_index),
+            scratch_shapes=scratch_shapes,
+        )
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+            interpret=_interpret(),
+        )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+          k_scales.astype(jnp.float32), v_scales.astype(jnp.float32),
+          q, k_pool, v_pool)
     kernel = functools.partial(_decode_kernel, block_size=bs,
                                n_blocks=nb, scale=float(scale))
     # index maps see the prefetched page table: sweep 1 follows it for
@@ -168,12 +337,7 @@ def paged_attention(q, k_pool, v_pool, page_table, lengths, scale=None):
         ],
         out_specs=pl.BlockSpec((1, 1, d),
                                lambda b_, h_, j, pt, ln: (b_, h_, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((nb, bs), jnp.float32),    # score / prob row
-            pltpu.VMEM((1, 1), jnp.float32),      # running max
-            pltpu.VMEM((1, 1), jnp.float32),      # softmax denominator
-            pltpu.VMEM((1, d), jnp.float32),      # output accumulator
-        ],
+        scratch_shapes=scratch_shapes,
     )
     return pl.pallas_call(
         kernel, grid_spec=grid_spec,
@@ -197,7 +361,7 @@ def _prefill_table_lengths(block_row, start, length, chunk):
 
 
 def paged_prefill_attention(q, k_pool, v_pool, block_row, start, length,
-                            scale=None):
+                            scale=None, k_scales=None, v_scales=None):
     """Chunked-prefill attention over a partially-resident page table.
 
     ``q``: [C, H, D] — one fixed-size chunk of prompt queries for ONE
@@ -216,17 +380,20 @@ def paged_prefill_attention(q, k_pool, v_pool, block_row, start, length,
     """
     table, lens = _prefill_table_lengths(block_row, start, length,
                                          q.shape[0])
-    return paged_attention(q, k_pool, v_pool, table, lens, scale=scale)
+    return paged_attention(q, k_pool, v_pool, table, lens, scale=scale,
+                           k_scales=k_scales, v_scales=v_scales)
 
 
 def paged_prefill_attention_reference(q, k_pool, v_pool, block_row,
-                                      start, length, scale=None):
+                                      start, length, scale=None,
+                                      k_scales=None, v_scales=None):
     """Dense oracle for :func:`paged_prefill_attention` (same staging
     as :func:`paged_attention_reference`, so parity stays bitwise)."""
     table, lens = _prefill_table_lengths(block_row, start, length,
                                          q.shape[0])
     return paged_attention_reference(q, k_pool, v_pool, table, lens,
-                                     scale=scale)
+                                     scale=scale, k_scales=k_scales,
+                                     v_scales=v_scales)
 
 
 def _verify_table_lengths(page_table, lengths, span):
@@ -245,7 +412,7 @@ def _verify_table_lengths(page_table, lengths, span):
 
 
 def paged_verify_attention(q, k_pool, v_pool, page_table, lengths,
-                           scale=None):
+                           scale=None, k_scales=None, v_scales=None):
     """Multi-token (draft-and-verify) ragged paged attention.
 
     ``q``: [B, S, H, D] — ``S`` query tokens per sequence (speculative
@@ -266,23 +433,26 @@ def paged_verify_attention(q, k_pool, v_pool, page_table, lengths,
     b, s, h, d = q.shape
     table, lens = _verify_table_lengths(page_table, lengths, s)
     o = paged_attention(q.reshape(b * s, h, d), k_pool, v_pool,
-                        table, lens, scale=scale)
+                        table, lens, scale=scale, k_scales=k_scales,
+                        v_scales=v_scales)
     return o.reshape(b, s, h, d)
 
 
 def paged_verify_attention_reference(q, k_pool, v_pool, page_table,
-                                     lengths, scale=None):
+                                     lengths, scale=None, k_scales=None,
+                                     v_scales=None):
     """Dense oracle for :func:`paged_verify_attention` (same staging as
     :func:`paged_attention_reference`, so parity stays bitwise)."""
     b, s, h, d = q.shape
     table, lens = _verify_table_lengths(page_table, lengths, s)
     o = paged_attention_reference(q.reshape(b * s, h, d), k_pool,
-                                  v_pool, table, lens, scale=scale)
+                                  v_pool, table, lens, scale=scale,
+                                  k_scales=k_scales, v_scales=v_scales)
     return o.reshape(b, s, h, d)
 
 
 def paged_attention_reference(q, k_pool, v_pool, page_table, lengths,
-                              scale=None):
+                              scale=None, k_scales=None, v_scales=None):
     """Pure-jnp dense oracle: gather every sequence's blocks into a
     dense [B, T_max, H, D] view, materialize the full score row, dense
     softmax, weighted sum.
@@ -296,12 +466,21 @@ def paged_attention_reference(q, k_pool, v_pool, page_table, lengths,
     """
     b, h, d = q.shape
     n_pool, bs, hp, dp = k_pool.shape
+    quantized = _check_quant_args(k_pool, v_pool, k_scales, v_scales)
     nb = page_table.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    k = k_pool[page_table]                      # [B, nb, bs, H, D]
-    v = v_pool[page_table]
+    k = k_pool[page_table].astype(jnp.float32)  # [B, nb, bs, H, D]
+    v = v_pool[page_table].astype(jnp.float32)
+    if quantized:
+        # dequantize elementwise with the gathered per-block scales —
+        # the same ``int8 -> f32 * scale`` product the kernel computes
+        # on the VMEM tile, so parity stays bitwise
+        k = k * k_scales.astype(jnp.float32)[page_table][
+            :, :, None, :, None]
+        v = v * v_scales.astype(jnp.float32)[page_table][
+            :, :, None, :, None]
     qf = q.astype(jnp.float32) * scale
-    s = jnp.sum(k.astype(jnp.float32) * qf[:, None, None], axis=-1)
+    s = jnp.sum(k * qf[:, None, None], axis=-1)
     s = jnp.moveaxis(s, 3, 1)                   # [B, H, nb, bs]
     pos = (jnp.arange(nb)[:, None] * bs +
            jnp.arange(bs)[None, :])             # [nb, bs]

@@ -68,6 +68,39 @@ def _expert_ffn(p, h):
     return jnp.maximum(h @ p["w1"], 0.0) @ p["w2"]
 
 
+def _expert_ffn_quant(p, h):
+    """The expert FFN over quantized weight leaves: both GEMMs stream
+    int8/fp8 weight bytes and fold the per-output-channel scales after
+    the K loop (znicz.gemm.quantized_matmul)."""
+    from ..gemm import quantized_matmul
+    a = jnp.maximum(quantized_matmul(h, p["w1_q"], p["w1_s"]), 0.0)
+    return quantized_matmul(a, p["w2_q"], p["w2_s"])
+
+
+def _quantize_weight_stack(w, dtype):
+    """Per-output-channel quantization of a stacked ``[..., K, N]``
+    weight (stages x experts leading dims) — the stacked counterpart of
+    :func:`~veles_tpu.znicz.gemm.quantize_weight`, sliced per stage and
+    per expert by the decode path's existing tree_map indexing."""
+    from ..gemm import _FP8_E4M3_MAX, fp8_dtype
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=-2)
+    if dtype == "int8":
+        scales = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(w / scales[..., None, :]), -127, 127)
+        return q.astype(jnp.int8), scales.astype(jnp.float32)
+    if dtype == "fp8":
+        f8 = fp8_dtype()
+        if f8 is None:
+            raise ValueError(
+                "weight_dtype='fp8' but this jaxlib exposes no float8 "
+                "dtype; use 'int8'")
+        scales = jnp.where(amax > 0, amax / _FP8_E4M3_MAX, 1.0)
+        return (w / scales[..., None, :]).astype(f8), \
+            scales.astype(jnp.float32)
+    raise ValueError("unknown weight dtype %r" % (dtype,))
+
+
 def _attend_block(params, h, heads, seq_axis=None, vary_axes=None,
                   use_pallas=False):
     b, t, d = h.shape
@@ -240,16 +273,92 @@ def init_decode_params(stages, experts, d=16, heads=2, hidden=32,
 
 
 def _stacked(params):
-    """The per-stage leaves (everything but the shared embedding)."""
-    return {n: params[n] for n in ("qkv", "proj", "wr", "w1", "w2")}
+    """The per-stage leaves (everything but the shared embedding).
+    When the param tree carries quantized expert weights (``w1_q`` ...)
+    those replace the f32 ``w1``/``w2`` leaves on every decode path."""
+    names = ("qkv", "proj", "wr")
+    if "w1_q" in params:
+        names += ("w1_q", "w1_s", "w2_q", "w2_s")
+    else:
+        names += ("w1", "w2")
+    return {n: params[n] for n in names}
 
 
 def _moe_dense(p_i, h, k):
     """No-drop oracle MoE for ``h`` [N, d]: capacity covers every
-    (token, choice) pair, so routing is per-token independent."""
+    (token, choice) pair, so routing is per-token independent.
+    Quantized expert leaves dispatch to the scaled-accumulate GEMM."""
+    if "w1_q" in p_i:
+        return moe_reference(
+            _expert_ffn_quant,
+            {n: p_i[n] for n in ("w1_q", "w1_s", "w2_q", "w2_s")},
+            p_i["wr"], h, capacity=h.shape[0] * k, k=k)
     return moe_reference(_expert_ffn,
                          {"w1": p_i["w1"], "w2": p_i["w2"]},
                          p_i["wr"], h, capacity=h.shape[0] * k, k=k)
+
+
+# -- quantized KV pools -------------------------------------------------------
+#
+# kv_dtype="int8" swaps each f32 pool array for {"q": int8 pool,
+# "s": f32 per-block scales} and every pool write for a sequential
+# quantized append: position off==0 resets the block's scale (so the
+# bytes a block ends up with depend only on the tokens written into it,
+# never on a previous tenant — the determinism prefix-chain dedupe
+# relies on), later positions grow the scale monotonically and rescale
+# the block's earlier rows when it grows.  With an unchanged scale the
+# rescale is exact (round(q * 1) == q), so closed blocks are stable.
+
+
+def _make_kv_pool(shape, kv_dtype):
+    """One per-layer pool: f32 array, or {"q", "s"} leaves for int8
+    (``s`` is the [num_blocks, heads] scale array the kernel
+    prefetches)."""
+    if kv_dtype == "int8":
+        return {"q": jnp.zeros(shape, jnp.int8),
+                "s": jnp.zeros((shape[0], shape[2]), jnp.float32)}
+    return jnp.zeros(shape, jnp.float32)
+
+
+def _kv_arrays(pool):
+    """(data, scales-or-None) view of a pool of either dtype."""
+    if isinstance(pool, dict):
+        return pool["q"], pool["s"]
+    return pool, None
+
+
+def _append_kv(pool, blk, off, vals, kv_dtype):
+    """Write ``vals`` at (blk, off).  f32: the exact ``.at[].set``
+    the unquantized path always used.  int8: per-position sequential
+    quantized append (see module note above); ``blk``/``off`` may be
+    [N] or [B, S] (flattened row-major, so positions within a row stay
+    in causal order)."""
+    if kv_dtype != "int8":
+        return pool.at[blk, off].set(vals)
+    q, s = pool["q"], pool["s"]
+    blk = blk.reshape(-1)
+    off = off.reshape(-1)
+    vals = vals.astype(jnp.float32).reshape((blk.shape[0],)
+                                            + q.shape[2:])
+
+    def body(t, carry):
+        q, s = carry
+        b, o, v = blk[t], off[t], vals[t]        # v: [H, hd]
+        s_old = jnp.where(o == 0, 0.0, s[b])     # [H]
+        s_new = jnp.maximum(s_old,
+                            jnp.max(jnp.abs(v), axis=-1) / 127.0)
+        s_safe = jnp.where(s_new > 0, s_new, 1.0)
+        # ratio == 0 wipes a freshly opened block; ratio == 1 keeps
+        # existing rows bit-exact when the scale did not grow
+        ratio = jnp.where(s_old > 0, s_old / s_safe, 0.0)
+        block = jnp.clip(jnp.round(q[b].astype(jnp.float32)
+                                   * ratio[None, :, None]), -127, 127)
+        row = jnp.clip(jnp.round(v / s_safe[:, None]), -127, 127)
+        block = block.at[o].set(row).astype(jnp.int8)
+        return q.at[b].set(block), s.at[b].set(s_new)
+
+    q, s = jax.lax.fori_loop(0, int(blk.shape[0]), body, (q, s))
+    return {"q": q, "s": s}
 
 
 def _prefill_block(p_i, h, heads, k):
@@ -267,7 +376,7 @@ def _prefill_block(p_i, h, heads, k):
 
 
 def prefill(params, tokens, length, k_pools, v_pools, block_row, *,
-            heads=2, block_size=8, k=1):
+            heads=2, block_size=8, k=1, kv_dtype="f32"):
     """Prompt pass: dense causal forward over ``tokens`` [T_bucket]
     (padded; ``length`` valid), writing each layer's K/V for positions
     < length into the pool blocks named by ``block_row`` [max_blocks].
@@ -287,15 +396,16 @@ def prefill(params, tokens, length, k_pools, v_pools, block_row, *,
     for i in range(stages):
         p_i = jax.tree.map(lambda p: p[i], stacked)
         h, kk, vv = _prefill_block(p_i, h, heads, k)
-        new_k.append(k_pools[i].at[blk, off].set(kk))
-        new_v.append(v_pools[i].at[blk, off].set(vv))
+        new_k.append(_append_kv(k_pools[i], blk, off, kk, kv_dtype))
+        new_v.append(_append_kv(v_pools[i], blk, off, vv, kv_dtype))
     logits = h[0, length - 1] @ params["emb"].T
     token = jnp.argmax(logits).astype(jnp.int32)
     return token, tuple(new_k), tuple(new_v)
 
 
 def prefill_chunk(params, tokens, start, length, k_pools, v_pools,
-                  block_row, *, heads=2, block_size=8, k=1):
+                  block_row, *, heads=2, block_size=8, k=1,
+                  kv_dtype="f32"):
     """One fixed-size prefill chunk: positions ``start .. start+C-1``
     of a prompt whose earlier K/V — resident prefix blocks reused from
     the pool plus chunks already executed — are read back THROUGH the
@@ -329,11 +439,14 @@ def prefill_chunk(params, tokens, start, length, k_pools, v_pools,
         q, kk, vv = (qkv[..., j * d:(j + 1) * d].reshape(1, c, heads,
                                                          hd)
                      for j in range(3))
-        k_pools[i] = k_pools[i].at[blk, off].set(kk[0])
-        v_pools[i] = v_pools[i].at[blk, off].set(vv[0])
-        a = paged_prefill_attention(q[0], k_pools[i], v_pools[i],
+        k_pools[i] = _append_kv(k_pools[i], blk, off, kk[0], kv_dtype)
+        v_pools[i] = _append_kv(v_pools[i], blk, off, vv[0], kv_dtype)
+        kd, ks = _kv_arrays(k_pools[i])
+        vd, vs = _kv_arrays(v_pools[i])
+        a = paged_prefill_attention(q[0], kd, vd,
                                     block_row, start, length,
-                                    scale=1.0 / math.sqrt(hd))
+                                    scale=1.0 / math.sqrt(hd),
+                                    k_scales=ks, v_scales=vs)
         h = h + a.reshape(1, c, d) @ p_i["proj"]
         moe = _moe_dense(p_i, _rmsnorm(h).reshape(c, d), k)
         h = h + moe.reshape(1, c, d)
@@ -344,7 +457,7 @@ def prefill_chunk(params, tokens, start, length, k_pools, v_pools,
 
 
 def _decode_block(p_i, h, k_pool_i, v_pool_i, page_table, lengths,
-                  blk, off, heads, k):
+                  blk, off, heads, k, kv_dtype="f32"):
     """One single-token block: write this token's K/V into its pool
     slot, then ragged paged attention over the whole cached history
     (lengths + 1 includes the token just written)."""
@@ -354,16 +467,20 @@ def _decode_block(p_i, h, k_pool_i, v_pool_i, page_table, lengths,
     qkv = _rmsnorm(h) @ p_i["qkv"]               # [B, 3d]
     q, kk, vv = (qkv[:, i * d:(i + 1) * d].reshape(b, heads, hd)
                  for i in range(3))
-    k_pool_i = k_pool_i.at[blk, off].set(kk)
-    v_pool_i = v_pool_i.at[blk, off].set(vv)
-    a = paged_attention(q, k_pool_i, v_pool_i, page_table, lengths + 1,
-                        scale=1.0 / math.sqrt(hd))
+    k_pool_i = _append_kv(k_pool_i, blk, off, kk, kv_dtype)
+    v_pool_i = _append_kv(v_pool_i, blk, off, vv, kv_dtype)
+    kd, ks = _kv_arrays(k_pool_i)
+    vd, vs = _kv_arrays(v_pool_i)
+    a = paged_attention(q, kd, vd, page_table, lengths + 1,
+                        scale=1.0 / math.sqrt(hd),
+                        k_scales=ks, v_scales=vs)
     h = h + a.reshape(b, d) @ p_i["proj"]
     return h + _moe_dense(p_i, _rmsnorm(h), k), k_pool_i, v_pool_i
 
 
 def decode_step(params, k_pools, v_pools, page_table, lengths, tokens,
-                *, heads=2, block_size=8, k=1):
+                *, heads=2, block_size=8, k=1, kv_dtype="f32",
+                with_logits=False):
     """One token for every row: embed ``tokens`` [B], write each row's
     K/V at position ``lengths[row]``, attend through the page table,
     return (next greedy tokens [B], k_pools, v_pools).
@@ -386,14 +503,16 @@ def decode_step(params, k_pools, v_pools, page_table, lengths, tokens,
         p_i = jax.tree.map(lambda p: p[i], stacked)
         h, k_pools[i], v_pools[i] = _decode_block(
             p_i, h, k_pools[i], v_pools[i], page_table, lengths, blk,
-            off, heads, k)
+            off, heads, k, kv_dtype=kv_dtype)
     logits = h @ params["emb"].T                 # [B, V]
-    return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-            tuple(k_pools), tuple(v_pools))
+    out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if with_logits:
+        return out, tuple(k_pools), tuple(v_pools), logits
+    return out, tuple(k_pools), tuple(v_pools)
 
 
 def _verify_block(p_i, h, k_pool_i, v_pool_i, page_table, lengths,
-                  blk, off, heads, k):
+                  blk, off, heads, k, kv_dtype="f32"):
     """One multi-token block of the speculative verify pass: write all
     S fed tokens' K/V into their pool slots, then ragged verify
     attention — per-position causal lengths keep query ``i`` blind to
@@ -405,17 +524,20 @@ def _verify_block(p_i, h, k_pool_i, v_pool_i, page_table, lengths,
     qkv = _rmsnorm(h) @ p_i["qkv"]               # [B, S, 3d]
     q, kk, vv = (qkv[..., i * d:(i + 1) * d].reshape(b, s, heads, hd)
                  for i in range(3))
-    k_pool_i = k_pool_i.at[blk, off].set(kk)
-    v_pool_i = v_pool_i.at[blk, off].set(vv)
-    a = paged_verify_attention(q, k_pool_i, v_pool_i, page_table,
-                               lengths, scale=1.0 / math.sqrt(hd))
+    k_pool_i = _append_kv(k_pool_i, blk, off, kk, kv_dtype)
+    v_pool_i = _append_kv(v_pool_i, blk, off, vv, kv_dtype)
+    kd, ks = _kv_arrays(k_pool_i)
+    vd, vs = _kv_arrays(v_pool_i)
+    a = paged_verify_attention(q, kd, vd, page_table,
+                               lengths, scale=1.0 / math.sqrt(hd),
+                               k_scales=ks, v_scales=vs)
     h = h + a.reshape(b, s, d) @ p_i["proj"]
     moe = _moe_dense(p_i, _rmsnorm(h).reshape(b * s, d), k)
     return h + moe.reshape(b, s, d), k_pool_i, v_pool_i
 
 
 def verify_step(params, k_pools, v_pools, page_table, lengths, tokens,
-                *, heads=2, block_size=8, k=1):
+                *, heads=2, block_size=8, k=1, kv_dtype="f32"):
     """Speculative verify: ``tokens`` [B, S] is each row's next input
     plus its S-1 draft tokens.  Every position is written at
     ``lengths[row] + i`` and attended with causal length
@@ -448,7 +570,7 @@ def verify_step(params, k_pools, v_pools, page_table, lengths, tokens,
         p_i = jax.tree.map(lambda p: p[i], stacked)
         h, k_pools[i], v_pools[i] = _verify_block(
             p_i, h, k_pools[i], v_pools[i], page_table, lengths, blk,
-            off, heads, k)
+            off, heads, k, kv_dtype=kv_dtype)
     logits = h @ params["emb"].T                 # [B, S, V]
     return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
             tuple(k_pools), tuple(v_pools))
@@ -481,13 +603,28 @@ class FlagshipDecodeModel:
     ModelRegistry.add dispatches on."""
 
     kind = "decode"
+    #: KV-cache precisions this model's factories accept (the
+    #: scheduler checks this before forwarding a non-default kv_dtype)
+    kv_dtypes = ("f32", "int8")
 
     def __init__(self, params=None, *, stages=2, experts=2, d=16,
-                 heads=2, hidden=32, vocab=64, k=1, seed=0):
+                 heads=2, hidden=32, vocab=64, k=1, seed=0,
+                 kv_dtype="f32", weight_dtype="f32"):
         if params is None:
             params = init_decode_params(stages, experts, d=d,
                                         heads=heads, hidden=hidden,
                                         vocab=vocab, seed=seed)
+        if kv_dtype not in self.kv_dtypes:
+            raise ValueError("kv_dtype=%r not in %r"
+                             % (kv_dtype, self.kv_dtypes))
+        if weight_dtype != "f32":
+            params = dict(params)
+            for name in ("w1", "w2"):
+                q, s = _quantize_weight_stack(params[name],
+                                              weight_dtype)
+                params[name + "_q"], params[name + "_s"] = q, s
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = weight_dtype
         self.params = params
         self.heads = int(heads)
         self.k = int(k)
@@ -500,49 +637,71 @@ class FlagshipDecodeModel:
         self.head_dim = self.d // self.heads
         self._draft_table = None
 
-    def make_pools(self, num_blocks, block_size):
+    def _kv(self, kv_dtype):
+        return self.kv_dtype if kv_dtype is None else kv_dtype
+
+    def make_pools(self, num_blocks, block_size, kv_dtype=None):
         """Fresh zeroed per-layer K and V pools
-        ([num_blocks, block_size, H, hd] x layers)."""
+        ([num_blocks, block_size, H, hd] x layers); int8 pools are
+        {"q", "s"} leaves per layer."""
+        dt = self._kv(kv_dtype)
         shape = (int(num_blocks), int(block_size), self.heads,
                  self.head_dim)
-        k_pools = tuple(jnp.zeros(shape, jnp.float32)
+        k_pools = tuple(_make_kv_pool(shape, dt)
                         for _ in range(self.layers))
-        v_pools = tuple(jnp.zeros(shape, jnp.float32)
+        v_pools = tuple(_make_kv_pool(shape, dt)
                         for _ in range(self.layers))
         return k_pools, v_pools
 
-    def prefill_fn(self, block_size):
+    def prefill_fn(self, block_size, kv_dtype=None):
         """(tokens, length, k_pools, v_pools, block_row) ->
         (first token, pools) — close over the static geometry."""
         params, heads, k = self.params, self.heads, self.k
+        dt = self._kv(kv_dtype)
 
         def fn(tokens, length, k_pools, v_pools, block_row):
             return prefill(params, tokens, length, k_pools, v_pools,
                            block_row, heads=heads,
-                           block_size=block_size, k=k)
+                           block_size=block_size, k=k, kv_dtype=dt)
         return fn
 
-    def prefill_chunk_fn(self, block_size):
+    def prefill_chunk_fn(self, block_size, kv_dtype=None):
         """(tokens[C], start, length, k_pools, v_pools, block_row) ->
         (token, pools) — the one-executable chunked-prefill step."""
         params, heads, k = self.params, self.heads, self.k
+        dt = self._kv(kv_dtype)
 
         def fn(tokens, start, length, k_pools, v_pools, block_row):
             return prefill_chunk(params, tokens, start, length,
                                  k_pools, v_pools, block_row,
                                  heads=heads, block_size=block_size,
-                                 k=k)
+                                 k=k, kv_dtype=dt)
         return fn
 
-    def decode_fn(self, block_size):
+    def decode_fn(self, block_size, kv_dtype=None):
         """(k_pools, v_pools, page_table, lengths, tokens) ->
         (next tokens, pools)."""
         params, heads, k = self.params, self.heads, self.k
+        dt = self._kv(kv_dtype)
 
         def fn(k_pools, v_pools, page_table, lengths, tokens):
             return decode_step(params, k_pools, v_pools, page_table,
                                lengths, tokens, heads=heads,
-                               block_size=block_size, k=k)
+                               block_size=block_size, k=k, kv_dtype=dt)
+        return fn
+
+    def logits_fn(self, block_size, kv_dtype=None):
+        """Like :meth:`decode_fn` but also returns the [B, V] logits —
+        the probe/bench hook for measuring quantization error against
+        the f32 oracle."""
+        params, heads, k = self.params, self.heads, self.k
+        dt = self._kv(kv_dtype)
+
+        def fn(k_pools, v_pools, page_table, lengths, tokens):
+            return decode_step(params, k_pools, v_pools, page_table,
+                               lengths, tokens, heads=heads,
+                               block_size=block_size, k=k, kv_dtype=dt,
+                               with_logits=True)
         return fn
 
     def _unigram_table(self):
@@ -562,7 +721,7 @@ class FlagshipDecodeModel:
                 logits, axis=-1).astype(jnp.int32)
         return self._draft_table
 
-    def draft_fn(self, block_size, depth):
+    def draft_fn(self, block_size, depth, kv_dtype=None):
         """(k_pools, v_pools, page_table, lengths, tokens[B]) ->
         draft tokens [B, depth].  Pure reads — drafting never writes
         the pools; acceptance is decided by the verify pass."""
@@ -578,16 +737,17 @@ class FlagshipDecodeModel:
             return jnp.stack(outs, axis=1)
         return fn
 
-    def verify_fn(self, block_size, depth):
+    def verify_fn(self, block_size, depth, kv_dtype=None):
         """(k_pools, v_pools, page_table, lengths, tokens[B, depth+1])
         -> (out tokens [B, depth+1], pools) — the one-pass multi-token
         verify the scheduler compiles once per speculation depth."""
         params, heads, k = self.params, self.heads, self.k
+        dt = self._kv(kv_dtype)
 
         def fn(k_pools, v_pools, page_table, lengths, tokens):
             return verify_step(params, k_pools, v_pools, page_table,
                                lengths, tokens, heads=heads,
-                               block_size=block_size, k=k)
+                               block_size=block_size, k=k, kv_dtype=dt)
         return fn
 
 
